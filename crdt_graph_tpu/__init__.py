@@ -1,0 +1,36 @@
+"""crdt_graph_tpu — a TPU-native replicated-tree CRDT framework.
+
+A ground-up JAX/XLA re-design of the replicated-tree CRDT implemented by the
+reference Elm package (``maca/crdt-replicated-tree`` v5.0.0): a tree whose
+branches are RGAs (Replicated Growable Arrays), mutated only through
+``Add``/``Delete``/``Batch`` operations, converging across replicas without
+coordination.
+
+Two engines share one protocol and one public API:
+
+- **oracle** (``crdt_graph_tpu.core``) — a sequential, persistent
+  pure-Python state machine with the reference's exact semantics.  It is the
+  correctness oracle for everything else and the right engine for
+  interactive, single-document use.
+- **tpu** (``crdt_graph_tpu.ops``) — operations as packed arrays; a replica
+  merge is ONE batched, jit-compiled semilattice join that materialises the
+  converged node table in RGA document order.  Scales across chips via
+  ``jax.sharding`` meshes (``crdt_graph_tpu.parallel``).
+
+The wire format (``crdt_graph_tpu.codec``) is byte-compatible with the
+reference JSON codec, so existing clients interoperate unchanged.
+"""
+
+from .core.errors import (AlreadyApplied, CRDTError, InvalidPathError,
+                          NotFound, OperationFailedError)
+from .core.operation import Add, Batch, Delete, Operation
+from .core.tree import CRDTree, DONE, TAKE, init
+from .core import timestamp
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Add", "AlreadyApplied", "Batch", "CRDTError", "CRDTree", "Delete",
+    "DONE", "InvalidPathError", "NotFound", "Operation",
+    "OperationFailedError", "TAKE", "init", "timestamp",
+]

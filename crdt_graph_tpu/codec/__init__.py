@@ -1,0 +1,2 @@
+"""Wire codecs: JSON (reference-compatible) and packed arrays (TPU-side)."""
+from . import json_codec

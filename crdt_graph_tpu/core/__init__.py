@@ -1,0 +1,6 @@
+"""Sequential oracle engine: reference-exact semantics on persistent values."""
+from . import node, operation, timestamp
+from .errors import (AlreadyApplied, CRDTError, InvalidPath, InvalidPathError,
+                     NotFound, OperationFailedError)
+from .operation import Add, Batch, Delete, Operation
+from .tree import CRDTree, DONE, TAKE, init

@@ -1,0 +1,30 @@
+"""Timestamp scheme: ``ts = replica_id * 2**32 + counter``.
+
+A replica's logical clock is a single integer whose high bits carry the
+replica id and whose low 32 bits carry a per-replica operation counter
+(reference: CRDTree/Timestamp.elm:16-18, CRDTree.elm:33-35,137).  The clock
+advances only for operations originated by the local replica
+(CRDTree.elm:337-343), so timestamps are per-replica sequence numbers — a
+vector clock entry — not a Lamport clock.
+
+Because every operation's timestamp embeds its origin, timestamps are
+globally unique, which makes them usable as node identities and as the final
+tie-break of every deterministic sort in the TPU kernels.
+"""
+
+REPLICA_SHIFT = 2**32
+
+
+def replica_id(timestamp: int) -> int:
+    """Extract the replica id from a timestamp (CRDTree/Timestamp.elm:16-18)."""
+    return timestamp // REPLICA_SHIFT
+
+
+def counter(timestamp: int) -> int:
+    """The per-replica sequence number in the low 32 bits."""
+    return timestamp % REPLICA_SHIFT
+
+
+def make(replica: int, count: int) -> int:
+    """Compose a timestamp from a replica id and a counter."""
+    return replica * REPLICA_SHIFT + count

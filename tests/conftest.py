@@ -1,0 +1,13 @@
+"""Test harness configuration.
+
+JAX tests run on a simulated 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware (the driver separately dry-runs the multi-chip
+path; benches run on the real chip).  Must be set before jax initialises.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,188 @@
+"""RGA node-kernel conformance suite.
+
+Port of the reference's tests/NodeTest.elm (185 LoC): drives the node kernel
+directly, pinning down the CRDT convergence rule (concurrent inserts after
+the same anchor converge regardless of arrival order, higher timestamp
+closer to the anchor) and the traversal combinators with tombstone skipping.
+"""
+import pytest
+
+from crdt_graph_tpu.core import node as N
+from crdt_graph_tpu.core.errors import AlreadyApplied, InvalidPath, NotFound
+
+
+def values(root):
+    return N.node_map(lambda n: n.get_value(), root)
+
+
+# -- add order: the canonical convergence fixtures (NodeTest.elm:23-60) ---
+
+def test_append_smaller_first():
+    root = N.add_after(N.Node.root(), [0], 1, "a")
+    root = N.add_after(root, [0], 2, "b")
+    assert values(root) == ["b", "a"]
+
+
+def test_append_bigger_first():
+    root = N.add_after(N.Node.root(), [0], 2, "b")
+    root = N.add_after(root, [0], 1, "a")
+    assert values(root) == ["b", "a"]
+
+
+def _insert_in_order(order):
+    """Six inserts: 1 after sentinel; 2 after 1; 3 after 2; then
+    {4,5,6} after 1 in the given arrival order (NodeTest.elm:150-167)."""
+    root = N.add_after(N.Node.root(), [0], 1, 1)
+    root = N.add_after(root, [1], 2, 2)
+    root = N.add_after(root, [2], 3, 3)
+    for ts in order:
+        root = N.add_after(root, [1], ts, ts)
+    return root
+
+
+@pytest.mark.parametrize("order", [(6, 5, 4), (4, 6, 5), (4, 5, 6),
+                                   (5, 4, 6), (5, 6, 4), (6, 4, 5)])
+def test_insert_converges_any_order(order):
+    assert values(_insert_in_order(order)) == [1, 6, 5, 4, 2, 3]
+
+
+# -- fixtures for traversal (NodeTest.elm:170-185) ------------------------
+
+@pytest.fixture
+def flat_example():
+    root = N.add_after(N.Node.root(), [0], 1, "a")
+    root = N.add_after(root, [1], 2, "b")
+    root = N.add_after(root, [2], 3, "x")
+    root = N.add_after(root, [3], 4, "c")
+    root = N.add_after(root, [4], 5, "d")
+    return N.delete(root, [3])
+
+
+@pytest.fixture
+def nested_example():
+    root = N.add_after(N.Node.root(), [0], 1, "a")
+    root = N.add_after(root, [1, 0], 2, "b")
+    root = N.add_after(root, [1, 2, 0], 3, "c")
+    root = N.add_after(root, [1, 2, 3, 0], 4, "d")
+    return root
+
+
+def test_find(flat_example):
+    found = N.find(lambda n: n.get_value() == "c", flat_example)
+    assert found is not None and found.get_value() == "c"
+
+
+def test_descendant(nested_example):
+    node = N.descendant(nested_example, [1, 2, 3, 4])
+    assert node is not None and node.get_value() == "d"
+
+
+def test_path(nested_example):
+    node = N.descendant(nested_example, [1, 2, 3, 4])
+    assert node.path == (1, 2, 3, 4)
+
+
+def test_timestamp(nested_example):
+    node = N.descendant(nested_example, [1, 2, 3, 4])
+    assert node.timestamp == 4
+
+
+def test_map_skips_tombstones(flat_example):
+    assert values(flat_example) == ["a", "b", "c", "d"]
+
+
+def test_filter_map(flat_example):
+    assert N.filter_map(lambda n: n.get_value(), flat_example) == \
+        ["a", "b", "c", "d"]
+
+
+def test_foldl(flat_example):
+    out = N.foldl(lambda n, acc: acc + [n.get_value()], [], flat_example)
+    assert out == ["a", "b", "c", "d"]
+
+
+def test_foldr(flat_example):
+    out = N.foldr(lambda n, acc: [n.get_value()] + acc, [], flat_example)
+    assert out == ["a", "b", "c", "d"]
+
+
+def test_head(flat_example):
+    assert N.head(flat_example).get_value() == "a"
+
+
+def test_last(flat_example):
+    assert N.last(flat_example).get_value() == "d"
+
+
+# -- kernel error semantics (Internal/Node.elm:63-65,112-122,138-163) -----
+
+def test_duplicate_add_raises_already_applied(flat_example):
+    with pytest.raises(AlreadyApplied):
+        N.add_after(flat_example, [1], 1, "dup")
+
+
+def test_missing_anchor_raises_not_found(flat_example):
+    with pytest.raises(NotFound):
+        N.add_after(flat_example, [99], 7, "zz")
+
+
+def test_empty_path_raises_invalid_path(flat_example):
+    with pytest.raises(InvalidPath):
+        N.add_after(flat_example, [], 7, "zz")
+
+
+def test_missing_intermediate_raises_invalid_path(flat_example):
+    with pytest.raises(InvalidPath):
+        N.add_after(flat_example, [42, 0], 7, "zz")
+
+
+def test_delete_tombstone_raises_already_applied(flat_example):
+    with pytest.raises(AlreadyApplied):
+        N.delete(flat_example, [3])
+
+
+def test_delete_missing_raises_not_found(flat_example):
+    with pytest.raises(NotFound):
+        N.delete(flat_example, [99])
+
+
+def test_add_under_tombstone_raises_already_applied(flat_example):
+    with pytest.raises(AlreadyApplied):
+        N.add_after(flat_example, [3, 0], 7, "zz")
+
+
+# -- tombstone-interleaved inserts (beyond the reference suite; see the
+#    divergence note in crdt_graph_tpu/core/node.py) ----------------------
+
+def test_insert_anchored_at_tombstone(flat_example):
+    # anchor at the tombstone ts=3: lands right after it, before "c"(4)
+    root = N.add_after(flat_example, [3], 6, "y")
+    assert values(root) == ["a", "b", "y", "c", "d"]
+
+
+def test_insert_before_tombstone(flat_example):
+    # anchored at "b"(2) with ts larger than the tombstone(3): stops
+    # immediately and lands between "b" and the tombstone.
+    root = N.add_after(flat_example, [2], 6, "y")
+    assert values(root) == ["a", "b", "y", "c", "d"]
+
+
+def test_insert_skips_past_tombstone():
+    # a tombstone with a larger timestamp is skipped like a live sibling:
+    # chain 0→10(a)→30(b†); inserting 20 after 10 must pass the tombstone.
+    root = N.add_after(N.Node.root(), [0], 10, "a")
+    root = N.add_after(root, [10], 30, "b")
+    root = N.delete(root, [30])
+    root = N.add_after(root, [10], 20, "c")
+    assert values(root) == ["a", "c"]
+    # and the tombstone still holds its position: ordering key intact
+    assert [n.timestamp for n in N.iter_chain(root)] == [10, 30, 20]
+
+
+def test_delete_after_tombstone_interleave(flat_example):
+    # regression for the reference findInsertion divergence: insert with a
+    # tombstone between anchor and successor, then delete the successor —
+    # the delete must still take effect.
+    root = N.add_after(flat_example, [2], 35, "y")  # lands before tombstone 3
+    root2 = N.delete(root, [4])  # delete "c"
+    assert values(root2) == ["a", "b", "y", "d"]

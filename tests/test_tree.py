@@ -1,0 +1,287 @@
+"""Replica state-machine conformance suite.
+
+Port of the reference's tests/CRDTreeTest.elm (684 LoC, 15 named cases).
+Each case checks the invariant triple the reference checks
+(tests/CRDTreeTest.elm:661-684): tree content at a path, the full
+chronological log, and the last broadcast operation.
+"""
+import pytest
+
+from crdt_graph_tpu import (Add, Batch, CRDTree, Delete, InvalidPathError,
+                            OperationFailedError, init)
+from crdt_graph_tpu.core import operation as op_mod
+
+OFFSET = 2**32
+
+
+def ops_since_zero(tree):
+    return op_mod.to_list(tree.operations_since(0))
+
+
+def expect_operations(tree, expected):
+    assert ops_since_zero(tree) == expected
+
+
+# -- adds node (CRDTreeTest.elm:56-82) ------------------------------------
+
+def test_add_node():
+    tree = init(0).add("a")
+    assert tree.get_value([1]) == "a"
+    expect_operations(tree, [Add(1, (0,), "a")])
+    assert tree.last_operation == Add(1, (0,), "a")
+
+
+# -- adds after node (CRDTreeTest.elm:85-122) -----------------------------
+
+def test_add_after():
+    tree = init(0).add("a").add("b").add_after([1], "c")
+    assert tree.get_value([1]) == "a"
+    assert tree.get_value([2]) == "b"
+    assert tree.get_value([3]) == "c"
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "b"),
+                             Add(3, (1,), "c")])
+    assert tree.last_operation == Add(3, (1,), "c")
+
+
+# -- adds between nodes (CRDTreeTest.elm:125-160) -------------------------
+
+def test_add_between_nodes():
+    tree = init(0).add("a").add("b").add("c").add_after([1], "z")
+    assert tree.visible_values() == ["a", "z", "b", "c"]
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "b"),
+                             Add(3, (2,), "c"), Add(4, (1,), "z")])
+    assert tree.last_operation == Add(4, (1,), "z")
+
+
+# -- batch (CRDTreeTest.elm:163-199) --------------------------------------
+
+def test_batch():
+    tree = init(0).batch([lambda t: t.add("a"), lambda t: t.add("b")])
+    assert tree.get_value([1]) == "a"
+    assert tree.get_value([2]) == "b"
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "b")])
+    assert tree.last_operation == Batch((Add(1, (0,), "a"),
+                                         Add(2, (1,), "b")))
+
+
+# -- adds branch (CRDTreeTest.elm:202-258) --------------------------------
+
+def test_add_branch():
+    tree = init(0).batch([
+        lambda t: t.add_branch("a"),
+        lambda t: t.add_branch("b"),
+        lambda t: t.add_branch("c"),
+        lambda t: t.add_branch("d"),
+        lambda t: t.add("e"),
+        lambda t: t.add("f"),
+    ])
+    operations = [
+        Add(1, (0,), "a"),
+        Add(2, (1, 0), "b"),
+        Add(3, (1, 2, 0), "c"),
+        Add(4, (1, 2, 3, 0), "d"),
+        Add(5, (1, 2, 3, 4, 0), "e"),
+        Add(6, (1, 2, 3, 4, 5), "f"),
+    ]
+    assert tree.get_value([1]) == "a"
+    assert tree.get_value([1, 2]) == "b"
+    assert tree.get_value([1, 2, 3]) == "c"
+    assert tree.get_value([1, 2, 3, 4]) == "d"
+    assert tree.get_value([1, 2, 3, 4, 5]) == "e"
+    assert tree.get_value([1, 2, 3, 4, 6]) == "f"
+    expect_operations(tree, operations)
+    assert tree.last_operation == Batch(tuple(operations))
+
+
+# -- delete marks node as tombstone (CRDTreeTest.elm:261-278) -------------
+
+def test_delete():
+    tree = init(0).add("a").delete([1])
+    assert tree.get_value([1]) is None
+    assert tree.last_operation == Delete((1,))
+
+
+# -- add to deleted branch is absorbed (CRDTreeTest.elm:281-321) ----------
+
+def test_add_to_deleted_branch():
+    batch = Batch((Add(1, (0,), "a"), Delete((1,)), Add(2, (1, 0), "b")))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1]) is None
+    expect_operations(tree, [Add(1, (0,), "a"), Delete((1,))])
+    assert tree.last_operation == Batch((Add(1, (0,), "a"), Delete((1,))))
+
+
+# -- applies several remote operations (CRDTreeTest.elm:324-358) ----------
+
+def test_apply_batch():
+    batch = Batch((Add(1, (0,), "a"), Add(2, (1,), "b")))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1]) == "a"
+    assert tree.get_value([2]) == "b"
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "b")])
+    assert tree.last_operation == batch
+
+
+# -- batch atomicity (CRDTreeTest.elm:482-498) ----------------------------
+
+def test_batch_atomicity():
+    batch = Batch((Add(1, (0,), "a"), Add(2, (9,), "b")))
+    with pytest.raises(OperationFailedError):
+        init(0).apply(batch)
+
+
+# -- Add is idempotent (CRDTreeTest.elm:361-398) --------------------------
+
+def test_add_is_idempotent():
+    batch = Batch(tuple(Add(1, (0,), "a") for _ in range(4)))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1]) == "a"
+    expect_operations(tree, [Add(1, (0,), "a")])
+    assert tree.last_operation == Batch((Add(1, (0,), "a"),))
+
+
+# -- insert at any position (CRDTreeTest.elm:401-440) ---------------------
+
+def test_insertion_between_nodes():
+    batch = Batch((Add(1, (0,), "a"), Add(2, (1,), "c"), Add(3, (1,), "b")))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1]) == "a"
+    assert tree.get_value([2]) == "c"
+    assert tree.get_value([3]) == "b"
+    # higher timestamp lands closer to the anchor
+    assert tree.visible_values() == ["a", "b", "c"]
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "c"),
+                             Add(3, (1,), "b")])
+    assert tree.last_operation == batch
+
+
+# -- inserts node as child of nested branch (CRDTreeTest.elm:443-479) -----
+
+def test_add_leaf():
+    batch = Batch((Add(1, (0,), "a"), Add(2, (1, 0), "b"),
+                   Add(3, (1, 2), "c")))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1, 2]) == "b"
+    assert tree.get_value([1, 3]) == "c"
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1, 0), "b"),
+                             Add(3, (1, 2), "c")])
+    assert tree.last_operation == batch
+
+
+# -- Delete is idempotent (CRDTreeTest.elm:501-544) -----------------------
+
+def test_delete_is_idempotent():
+    batch = Batch((Add(1, (0,), "a"),) + tuple(Delete((1,))
+                                               for _ in range(5)))
+    tree = init(0).apply(batch)
+    assert tree.get_value([1]) is None
+    expect_operations(tree, [Add(1, (0,), "a"), Delete((1,))])
+    assert tree.last_operation == Batch((Add(1, (0,), "a"), Delete((1,))))
+
+
+# -- timestamps carry the replica offset (CRDTreeTest.elm:547-589) --------
+
+def test_timestamps_replica_0():
+    tree = init(0).batch([lambda t: t.add("a"), lambda t: t.add("b"),
+                          lambda t: t.add("c")])
+    expect_operations(tree, [Add(1, (0,), "a"), Add(2, (1,), "b"),
+                             Add(3, (2,), "c")])
+
+
+def test_timestamps_replica_1():
+    tree = init(1).batch([lambda t: t.add("a"), lambda t: t.add("b"),
+                          lambda t: t.add("c")])
+    expect_operations(tree, [
+        Add(OFFSET + 1, (0,), "a"),
+        Add(OFFSET + 2, (OFFSET + 1,), "b"),
+        Add(OFFSET + 3, (OFFSET + 2,), "c"),
+    ])
+
+
+# -- operationsSince (CRDTreeTest.elm:592-658) ----------------------------
+
+@pytest.fixture
+def since_tree():
+    batch = Batch((
+        Add(1, (0,), "a"), Add(2, (1,), "b"), Add(3, (2,), "c"),
+        Add(4, (3,), "d"), Delete((3,)), Batch(()),
+        Add(5, (4,), "e"), Add(6, (5,), "f"),
+    ))
+    return init(0).apply(batch)
+
+
+def test_operations_since_beginning(since_tree):
+    assert ops_since_zero(since_tree) == [
+        Add(1, (0,), "a"), Add(2, (1,), "b"), Add(3, (2,), "c"),
+        Add(4, (3,), "d"), Delete((3,)), Add(5, (4,), "e"),
+        Add(6, (5,), "f"),
+    ]
+
+
+def test_operations_since_2(since_tree):
+    assert op_mod.to_list(since_tree.operations_since(2)) == [
+        Add(2, (1,), "b"), Add(3, (2,), "c"), Add(4, (3,), "d"),
+        Delete((3,)), Add(5, (4,), "e"), Add(6, (5,), "f"),
+    ]
+
+
+def test_operations_since_last(since_tree):
+    assert op_mod.to_list(since_tree.operations_since(6)) == [
+        Add(6, (5,), "f")]
+
+
+def test_operations_since_unknown_returns_empty(since_tree):
+    assert op_mod.to_list(since_tree.operations_since(10)) == []
+
+
+# -- beyond the reference suite: replica/vector-clock accessors -----------
+
+def test_replica_bookkeeping():
+    a = init(1).add("a").add("b")
+    b = init(2).apply(a.operations_since(0))
+    assert b.last_replica_timestamp(1) == OFFSET + 2
+    assert b.last_replica_timestamp(2) == 0  # b originated nothing
+    assert b.visible_values() == a.visible_values() == ["a", "b"]
+    # remote application must not advance the local clock
+    assert b.timestamp == 2 * OFFSET
+
+
+def test_cursor_semantics():
+    tree = init(0).add_branch("a").add_branch("b")
+    assert tree.cursor == (1, 2, 0)
+    tree = tree.add("c")
+    assert tree.cursor == (1, 2, 3)
+    assert tree.move_cursor_up().cursor == (1, 2)
+    # remote apply restores the local cursor
+    remote = Add(5 * OFFSET + 1, (0,), "x")
+    assert tree.apply(remote).cursor == tree.cursor
+
+
+def test_delete_moves_cursor_to_predecessor():
+    tree = init(0).add("a").add("b").add("c")
+    tree = tree.delete([2])
+    assert tree.cursor == (1,)
+    assert tree.visible_values() == ["a", "c"]
+
+
+def test_delete_cursor_lands_on_tombstone_predecessor():
+    # the predecessor search walks raw next pointers, tombstones included
+    # (Internal/Node.elm:166-183 via CRDTree.elm:199-216): after deleting
+    # "a" then "b", the cursor points at a's tombstone path, not at "b".
+    tree = init(0).add("a").add("b").delete([1]).delete([2])
+    assert tree.cursor == (1,)
+
+
+def test_set_cursor_missing_raises_not_found():
+    from crdt_graph_tpu import NotFound
+    with pytest.raises(NotFound):
+        init(0).add("a").set_cursor([9])
+
+
+def test_invalid_path_errors():
+    with pytest.raises(InvalidPathError):
+        init(0).apply(Add(1, (), "a"))
+    with pytest.raises(InvalidPathError):
+        init(0).add("a").apply(Add(7, (9, 0), "b"))
+    with pytest.raises(OperationFailedError):
+        init(0).delete([1])
