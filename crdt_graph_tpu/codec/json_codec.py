@@ -82,9 +82,8 @@ def decode(obj: dict, value_decoder: Callable[[Any], Any] = Identity
         except (KeyError, TypeError, ValueError) as e:
             raise DecodeError(f"malformed del: {obj!r}") from e
     if tag == "batch":
-        try:
-            ops = obj["ops"]
-        except (TypeError, KeyError):
+        ops = obj.get("ops")
+        if not isinstance(ops, list):
             raise DecodeError(f"malformed batch: {obj!r}")
         return Batch(tuple(decode(o, value_decoder) for o in ops))
     return Batch(())

@@ -1,0 +1,195 @@
+"""Packed operation arrays: the host↔device boundary of the TPU engine.
+
+An operation batch becomes a struct-of-arrays with static shapes so the merge
+kernel can be traced once and reused.  Values never cross the boundary — the
+kernel is payload-oblivious; each Add carries an index into a host-side value
+table (``value_ref``), and the merged node table refers back into it.
+
+Layout (N = padded op count, D = maximum path length):
+
+- ``kind``       i8[N]   — 0 add, 1 delete, 2 padding
+- ``ts``         i64[N]  — add: the new node's timestamp; delete: the
+                           target's timestamp (= last path element)
+- ``parent_ts``  i64[N]  — second-to-last path element, 0 at root level
+- ``anchor_ts``  i64[N]  — add: last path element (0 = branch-head sentinel)
+- ``depth``      i32[N]  — path length
+- ``paths``      i64[N,D] — the full claimed path, zero-padded; used by the
+                            kernel to validate ops against materialised
+                            ancestor paths
+- ``value_ref``  i32[N]  — index into the host value table, -1 if none
+- ``pos``        i32[N]  — position in the original batch order; the kernel
+                           uses it for first-arrival-wins dedup and for
+                           sequential-parity statuses
+
+Timestamps are int64: ``replica_id * 2**32 + counter`` exceeds int32 by
+design (core/timestamp.py).  Shapes are padded to buckets (powers of two) so
+jit caches stay small.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import operation as op_mod
+from ..core.operation import Add, Batch, Delete, Operation
+
+KIND_ADD = 0
+KIND_DELETE = 1
+KIND_PAD = 2
+
+DEFAULT_MAX_DEPTH = 16
+
+# Timestamps at or above this are reserved as kernel sentinels.  The protocol
+# value space (replica_id * 2**32 + counter) reaches it only for replica ids
+# >= 2**30 — pack() rejects those loudly rather than letting the kernel treat
+# them as padding.
+MAX_TS = 2**62
+
+
+@dataclasses.dataclass
+class PackedOps:
+    """A batch of operations as fixed-shape arrays plus a host value table."""
+
+    kind: np.ndarray
+    ts: np.ndarray
+    parent_ts: np.ndarray
+    anchor_ts: np.ndarray
+    depth: np.ndarray
+    paths: np.ndarray
+    value_ref: np.ndarray
+    pos: np.ndarray
+    values: List[Any]
+    num_ops: int  # real (unpadded) op count
+
+    @property
+    def capacity(self) -> int:
+        return int(self.kind.shape[0])
+
+    @property
+    def max_depth(self) -> int:
+        return int(self.paths.shape[1])
+
+    def arrays(self) -> dict:
+        """The device-bound fields (everything but the value table)."""
+        return {
+            "kind": self.kind, "ts": self.ts, "parent_ts": self.parent_ts,
+            "anchor_ts": self.anchor_ts, "depth": self.depth,
+            "paths": self.paths, "value_ref": self.value_ref, "pos": self.pos,
+        }
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
+         capacity: Optional[int] = None) -> PackedOps:
+    """Flatten an operation (or iterable of operations) into packed arrays.
+
+    Batches are flattened depth-first; ``pos`` records the resulting
+    sequential order.  Out-of-range input raises rather than truncating:
+    paths longer than ``max_depth`` (re-pack deeper) and timestamps or path
+    elements outside ``[0, MAX_TS)`` (the kernel's sentinel space).
+    """
+    if isinstance(ops, (Add, Delete, Batch)):
+        ops = [ops]
+    flat: List[Operation] = []
+    for op in ops:
+        flat.extend(op_mod.iter_leaves(op))
+
+    n = len(flat)
+    cap = capacity if capacity is not None else _bucket(n)
+    if cap < n:
+        raise ValueError(f"capacity {cap} < op count {n}")
+
+    kind = np.full(cap, KIND_PAD, dtype=np.int8)
+    ts = np.zeros(cap, dtype=np.int64)
+    parent_ts = np.zeros(cap, dtype=np.int64)
+    anchor_ts = np.zeros(cap, dtype=np.int64)
+    depth = np.zeros(cap, dtype=np.int32)
+    paths = np.zeros((cap, max_depth), dtype=np.int64)
+    value_ref = np.full(cap, -1, dtype=np.int32)
+    pos = np.arange(cap, dtype=np.int32)
+    values: List[Any] = []
+
+    for i, op in enumerate(flat):
+        path = op.path
+        if len(path) > max_depth:
+            raise ValueError(
+                f"path depth {len(path)} exceeds max_depth {max_depth}; "
+                f"re-pack with a larger max_depth")
+        d = len(path)
+        if any(e < 0 or e >= MAX_TS for e in path) or \
+                (isinstance(op, Add) and not 0 <= op.ts < MAX_TS):
+            raise ValueError(
+                f"timestamp outside [0, 2**62) in {op!r}; replica ids must "
+                f"be < 2**30")
+        paths[i, :d] = path
+        depth[i] = d
+        if isinstance(op, Add):
+            kind[i] = KIND_ADD
+            ts[i] = op.ts
+            anchor_ts[i] = path[-1] if path else 0
+            parent_ts[i] = path[-2] if len(path) >= 2 else 0
+            value_ref[i] = len(values)
+            values.append(op.value)
+        else:  # Delete
+            kind[i] = KIND_DELETE
+            ts[i] = path[-1] if path else 0
+            anchor_ts[i] = path[-1] if path else 0
+            parent_ts[i] = path[-2] if len(path) >= 2 else 0
+
+    return PackedOps(kind=kind, ts=ts, parent_ts=parent_ts,
+                     anchor_ts=anchor_ts, depth=depth, paths=paths,
+                     value_ref=value_ref, pos=pos, values=values, num_ops=n)
+
+
+def unpack(packed: PackedOps) -> List[Operation]:
+    """Packed arrays → operation list (inverse of :func:`pack`)."""
+    out: List[Operation] = []
+    for i in range(packed.num_ops):
+        d = int(packed.depth[i])
+        path = tuple(int(x) for x in packed.paths[i, :d])
+        if packed.kind[i] == KIND_ADD:
+            ref = int(packed.value_ref[i])
+            out.append(Add(int(packed.ts[i]), path, packed.values[ref]))
+        elif packed.kind[i] == KIND_DELETE:
+            out.append(Delete(path))
+    return out
+
+
+def concat(a: PackedOps, b: PackedOps) -> PackedOps:
+    """Concatenate two packed batches (the semilattice union before a merge).
+
+    ``b``'s positions are shifted after ``a``'s so first-arrival dedup keeps
+    ``a``'s copies — matching sequential application order a-then-b.
+    """
+    if a.max_depth != b.max_depth:
+        raise ValueError("mismatched max_depth")
+    n = a.num_ops + b.num_ops
+    cap = _bucket(n)
+    out = PackedOps(
+        kind=np.full(cap, KIND_PAD, dtype=np.int8),
+        ts=np.zeros(cap, dtype=np.int64),
+        parent_ts=np.zeros(cap, dtype=np.int64),
+        anchor_ts=np.zeros(cap, dtype=np.int64),
+        depth=np.zeros(cap, dtype=np.int32),
+        paths=np.zeros((cap, a.max_depth), dtype=np.int64),
+        value_ref=np.full(cap, -1, dtype=np.int32),
+        pos=np.arange(cap, dtype=np.int32),
+        values=list(a.values) + list(b.values),
+        num_ops=n)
+    na, nb = a.num_ops, b.num_ops
+    for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth", "paths"):
+        getattr(out, name)[:na] = getattr(a, name)[:na]
+        getattr(out, name)[na:n] = getattr(b, name)[:nb]
+    out.value_ref[:na] = a.value_ref[:na]
+    shifted = b.value_ref[:nb].copy()
+    shifted[shifted >= 0] += len(a.values)
+    out.value_ref[na:n] = shifted
+    return out

@@ -1,0 +1,13 @@
+"""TPU kernels: the array engine's compute path.
+
+Everything here is jit-compiled JAX with static shapes — no data-dependent
+Python control flow, sorts with fully deterministic composite keys (the
+globally unique timestamp is the final tie-break everywhere), and
+pointer-doubling loops with trace-time trip counts.
+
+Timestamps are int64 (``replica_id * 2**32 + counter``); kernels scope
+64-bit mode internally (``jax.enable_x64``) rather than
+mutating process-global JAX config at import.
+"""
+from . import merge, view
+from .merge import NodeTable, materialize

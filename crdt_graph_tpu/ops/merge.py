@@ -1,0 +1,383 @@
+"""The batched semilattice join: N operations → converged node table, jitted.
+
+This kernel replaces the reference's sequential merge — a left fold of
+single-op tree edits, O(ops × depth × siblings)
+(CRDTree.elm:224-232, 408-418) — with one data-parallel pass whose depth is
+O(log N) pointer-doubling steps.  It treats the operation batch as an
+unordered SET: applying it is a semilattice join, so merging replicas is
+just concatenating their op arrays and materialising.  Idempotence,
+commutativity and convergence hold by construction.
+
+The central idea: **RGA document order is the DFS pre-order of an "order
+forest"** derived from the ops alone.
+
+Getting this forest right is subtle — the sequential skip-scan (insert after
+the anchor, walking right past siblings with larger timestamps,
+Internal/Node.elm:93-104) does NOT yield the naive anchor-forest DFS: a
+low-timestamp insert can come to rest deep inside another anchor's subtree
+(RGA's well-known interleaving behaviour).  The converged order it does
+yield is the *greedy max-timestamp linearisation* of the anchor forest —
+repeatedly emit the largest-timestamp node whose anchor has already been
+emitted — which is equivalent to the DFS pre-order of the **min-ancestor
+tree** T*:
+
+- Within a branch, each node's T* parent is the NEAREST node on its anchor
+  chain with a SMALLER timestamp (chain exhausted → the branch head).
+- T* children sort timestamp-DESCENDING; T* chains are timestamp-increasing
+  downward.
+
+Why: whether x is emitted before y is decided by the race of their anchor
+chains from the deepest common ancestor — at every step the larger available
+front goes first, so the chain whose remaining MINIMUM is larger always
+exhausts first.  Folding that pairwise rule over all nodes orders them by
+lexicographic-descending comparison of each node's suffix-minima chain
+(nearest smaller ancestor, then its nearest smaller ancestor, …), and that
+comparison is exactly pre-order over T*.  The oracle's convergence across
+delivery orders — and the kernel's agreement with it — is pinned by the
+random-delivery suites in tests/test_merge_kernel.py.
+
+The whole-tree document order interleaves branches, per the reference's
+``walk`` (CRDTree.elm:583-625): a node, then its own branch contents, then
+the siblings spliced after it.  So the combined order forest hangs, under
+every node, first its child branch's T* roots (group 0), then its
+same-branch T* children (group 1), each group timestamp-descending.
+Pre-order ranks are computed without recursion by building the Euler tour of
+this forest (enter/exit token per node, successor pointers from one sibling
+sort) and running Wyllie pointer-doubling list ranking — ⌈log2(2M)⌉ gather
+passes.  The nearest-smaller-ancestor chase is O(log N) pointer-halving
+rounds.
+
+Deletes tombstone a node and kill its whole subtree (a tombstone's children
+are discarded, Internal/Node.elm:237-238); tombstones keep their list
+position, so they stay in the order forest and are masked only from the
+visible sequence.
+
+Sequential-parity statuses: the reference applies a batch in order, so
+whether an op is "applied" vs "absorbed" can depend on batch position
+(add-under-branch-then-delete logs the add; delete-then-add absorbs it —
+the final TREE is identical either way).  The kernel reports a status per op
+using batch positions (first-arrival dedup, tombstone-before-me absorption),
+exact for causally ordered logs; the converged tree itself is order-
+independent.
+
+Reference parity targets: Internal/Node.elm (RGA insert/delete semantics),
+CRDTree.elm:275-325 (apply semantics), with the two documented divergences
+from crdt_graph_tpu/core/node.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..codec.packed import KIND_ADD, KIND_DELETE, KIND_PAD, MAX_TS
+
+# Per-op result statuses (sequential parity; see module docstring).
+APPLIED = 0
+ALREADY_APPLIED = 1   # duplicate add / repeat delete / edit under tombstone
+NOT_FOUND = 2         # anchor or delete target missing from its branch
+INVALID_PATH = 3      # empty path, missing intermediate, or prefix mismatch
+PAD = 4
+
+BIG = MAX_TS          # sorts-after-everything timestamp sentinel (python int:
+                      # promotes against int64 arrays without x64-mode issues)
+IPOS = 2**31 - 1      # "no position" / +inf for int32 positions
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NodeTable:
+    """The converged tree as arrays over M = N + 2 slots.
+
+    Slot 0 is the root; slots 1..N hold nodes (one per unique valid Add —
+    unused slots have ``exists=False``); slot M-1 is a null sink.  Document
+    order is the RGA walk order; ``order`` lists existing-node slots in that
+    order (padded with the null slot), ``visible_order`` the same after
+    tombstone/dead masking.
+    """
+
+    ts: jax.Array           # i64[M] node timestamp (0 = root, BIG = unused)
+    parent: jax.Array       # i32[M] tree-parent slot (root: itself)
+    depth: jax.Array        # i32[M]
+    value_ref: jax.Array    # i32[M] host value-table index, -1 none
+    paths: jax.Array        # i64[M, D] full materialised path, zero-padded
+    exists: jax.Array       # bool[M] slot holds a real, valid node
+    tombstone: jax.Array    # bool[M] node itself deleted
+    dead: jax.Array         # bool[M] some strict ancestor deleted
+    visible: jax.Array      # bool[M] exists & ~tombstone & ~dead
+    doc_index: jax.Array    # i32[M] position in document order (IPOS if none)
+    order: jax.Array        # i32[M] slots of existing nodes in doc order
+    visible_order: jax.Array  # i32[M] slots of visible nodes in doc order
+    num_nodes: jax.Array    # i32 count of existing nodes
+    num_visible: jax.Array  # i32 count of visible nodes
+    status: jax.Array       # i8[N] per-op status (original batch order)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.ts.shape[0]) - 2
+
+    @property
+    def null_slot(self) -> int:
+        return int(self.ts.shape[0]) - 1
+
+
+def _ceil_log2(n: int) -> int:
+    return max(1, math.ceil(math.log2(max(2, n))))
+
+
+def materialize(ops: Dict[str, jax.Array]) -> NodeTable:
+    """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
+
+    Timestamps are int64, so the kernel requires 64-bit mode; if the host
+    program runs JAX in default x32 mode, tracing and input conversion are
+    scoped inside ``jax.enable_x64`` rather than flipping the process-global
+    flag.
+    """
+    if jax.config.jax_enable_x64:
+        return _materialize(ops)
+    with jax.enable_x64(True):
+        return _materialize(ops)
+
+
+@jax.jit
+def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
+    kind = ops["kind"]
+    ts = ops["ts"].astype(jnp.int64)
+    parent_ts = ops["parent_ts"].astype(jnp.int64)
+    anchor_ts = ops["anchor_ts"].astype(jnp.int64)
+    depth = ops["depth"].astype(jnp.int32)
+    paths = ops["paths"].astype(jnp.int64)
+    value_ref = ops["value_ref"].astype(jnp.int32)
+    pos = ops["pos"].astype(jnp.int32)
+
+    N = kind.shape[0]
+    D = paths.shape[1]
+    M = N + 2
+    ROOT = 0
+    NULL = M - 1
+
+    is_add = kind == KIND_ADD
+    is_del = kind == KIND_DELETE
+
+    # ---- 1. Sort adds by (ts, pos); first arrival of a timestamp wins
+    # (idempotence, Internal/Node.elm:63-65).  Non-adds sink to the end.
+    sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
+    sorted_ts, sorted_pos, sorted_idx = lax.sort(
+        (sort_ts, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+    run_start = jnp.concatenate(
+        [jnp.ones(1, bool), sorted_ts[1:] != sorted_ts[:-1]])
+    is_canon = run_start & (sorted_ts < BIG)
+    # slot of the run's canonical add = run-start index + 1
+    canon_pos = lax.cummax(jnp.where(run_start,
+                                     jnp.arange(N, dtype=jnp.int32), 0))
+    slot_of_sorted = canon_pos + 1
+    # per-op: node slot and duplicate flag (original batch order)
+    op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
+        jnp.where(sorted_ts < BIG, slot_of_sorted, NULL))
+    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
+        ~run_start & (sorted_ts < BIG))
+
+    # ---- 2. Scatter canonical adds into the node table (slots 1..N).
+    tgt = jnp.where(is_canon, slot_of_sorted, NULL)
+
+    def scat(init, vals, at=tgt):
+        return init.at[at].set(vals, mode="drop")
+
+    g = lambda a: a[sorted_idx]  # noqa: E731  original-order field, sorted
+    node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
+        .at[NULL].set(BIG)
+    node_parent_ts = scat(jnp.zeros(M, jnp.int64), g(parent_ts))
+    node_anchor_ts = scat(jnp.zeros(M, jnp.int64), g(anchor_ts))
+    node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
+    node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
+    node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
+    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
+        paths[sorted_idx], mode="drop")
+    is_node_slot = scat(jnp.zeros(M, bool), is_canon)
+
+    # Full materialised path: claimed anchor path with the node's own ts in
+    # the last position (Internal/Node.elm:79-82).
+    col = jnp.clip(node_depth - 1, 0, D - 1)
+    fp = node_claimed.at[jnp.arange(M), col].set(
+        jnp.where(node_depth > 0, node_ts, node_claimed[jnp.arange(M), col]))
+
+    # ---- 3. Timestamp → slot lookup over the sorted add axis.
+    def lookup(q: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        idx = jnp.searchsorted(sorted_ts, q, side="left").astype(jnp.int32)
+        idx_c = jnp.minimum(idx, N - 1)
+        hit = (sorted_ts[idx_c] == q) & (q > 0) & (q < BIG)
+        slot = jnp.where(q == 0, ROOT, jnp.where(hit, idx_c + 1, NULL))
+        return slot, (q == 0) | hit
+
+    # ---- 4. Resolve parents/anchors; local validity per node slot.
+    pslot, pfound = lookup(node_parent_ts)
+    pslot = jnp.where(jnp.arange(M) == ROOT, ROOT, pslot)
+    aslot, afound = lookup(node_anchor_ts)
+
+    # claimed prefix (first depth-1 elements) must equal the parent's full
+    # path — this is what "descending the path" validates in the reference
+    # (Internal/Node.elm:138-163).
+    dmask = jnp.arange(D)[None, :] < (node_depth[:, None] - 1)
+    prefix_ok = jnp.all(jnp.where(dmask, node_claimed == fp[pslot], True),
+                        axis=1)
+    depth_ok = (node_depth >= 1) & (node_depth <= D) & \
+        (node_depth == node_depth[pslot] + 1)
+    parent_ok = pfound & depth_ok & prefix_ok
+    sentinel_anchor = node_anchor_ts == 0
+    anchor_ok = sentinel_anchor | (afound & (pslot[aslot] == pslot) &
+                                   (aslot != ROOT))
+    local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
+    local_ok = local_ok.at[ROOT].set(True)
+
+    # ---- 5. Validity cascades along the order forest: a node exists only if
+    # its anchor chain and tree ancestors all exist.
+    order_parent = jnp.where(sentinel_anchor, pslot, aslot)
+    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
+    ok, ptr = local_ok, order_parent
+    for _ in range(_ceil_log2(M) + 1):
+        ok = ok & ok[ptr]
+        ptr = ptr[ptr]
+    valid = ok
+    # canonical parent pointer for existing nodes; root for itself
+    parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
+
+    # ---- 6. Deletes: tombstone valid targets (first delete per target wins
+    # the log; the tree flag is an idempotent OR either way).
+    d_tslot, d_tfound = lookup(ts)
+    d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
+    d_dmask = jnp.arange(D)[None, :] < depth[:, None]
+    d_path_ok = jnp.all(jnp.where(d_dmask, paths == fp[d_tslot], True),
+                        axis=1)
+    d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
+        d_depth_ok & d_path_ok
+    d_tgt = jnp.where(d_ok, d_tslot, NULL)
+    deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
+    del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
+        .at[NULL].set(IPOS)
+
+    # ---- 7. Dead-subtree propagation down tree-parent chains (delete
+    # discards descendants, Internal/Node.elm:237-238).  Also carries the
+    # earliest ancestor-delete position for absorption statuses.
+    anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
+    jmp = parent_eff
+    for _ in range(_ceil_log2(D) + 1):
+        anc_del = jnp.minimum(anc_del, anc_del[jmp])
+        jmp = jmp[jmp]
+    dead = valid & (anc_del < IPOS)
+
+    # ---- 8. The order forest: each node's T* parent is the nearest node on
+    # its within-branch anchor chain with a SMALLER timestamp (-1 = chain
+    # exhausted at the branch head).  Pointer-halving chase: when the current
+    # candidate m has ts > ours, everything m itself skipped is > ts(m) > ours,
+    # so jumping to m's own candidate skips no answer of ours.
+    in_forest = valid & is_node_slot
+    mptr = jnp.where(sentinel_anchor | ~in_forest, -1, aslot)
+    for _ in range(_ceil_log2(M) + 1):
+        m = jnp.where(mptr >= 0, mptr, NULL)
+        unresolved = (mptr >= 0) & (node_ts[m] > node_ts)
+        mptr = jnp.where(unresolved, mptr[m], mptr)
+    star_parent = jnp.where(mptr >= 0, mptr, pslot)
+    star_sentinel = mptr < 0
+
+    # Sibling sort → Euler-tour successor pointers.  Children of p: child-
+    # branch T* roots first (group 0), then same-branch T* children (group
+    # 1); each group timestamp-DESCENDING (the RGA rule: higher timestamp
+    # closer to the anchor).
+    order_parent = jnp.where(in_forest, star_parent, order_parent)
+    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
+    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
+    ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
+    neg_ts = jnp.where(in_forest, -node_ts, BIG)
+    s_parent, _, _, s_slot = lax.sort(
+        (skey, ggrp, neg_ts, jnp.arange(M, dtype=jnp.int32)), num_keys=3)
+    same_parent = s_parent[1:] == s_parent[:-1]
+    # next sibling within the concatenated child list; the root never sits in
+    # a sibling list (its exit token is the chain terminal below)
+    sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
+        jnp.where(same_parent, s_slot[1:], -1)).at[ROOT].set(-1)
+    # first child of each parent = slot at every parent-run start
+    s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
+    fc_tgt = jnp.where(s_start, s_parent, NULL)
+    first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
+        s_slot, mode="drop").at[NULL].set(-1)
+
+    # Tokens: enter(v) = v, exit(v) = M + v.  succ forms chains ending in the
+    # self-loop at exit(root); parked tokens (invalid slots) never feed real
+    # chains, so their ranks are garbage that is masked out below.
+    T = 2 * M
+    tok = jnp.arange(T, dtype=jnp.int32)
+    enter_succ = jnp.where(first_child >= 0, first_child,
+                           M + jnp.arange(M, dtype=jnp.int32))
+    up = jnp.where(order_parent == jnp.arange(M), M + jnp.arange(M),
+                   M + order_parent)
+    exit_succ = jnp.where(sib_next >= 0, sib_next, up)
+    succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
+
+    # ---- 9. Wyllie list ranking: distance to each chain's terminal.
+    dist = jnp.where(succ == tok, 0, 1).astype(jnp.int32)
+    for _ in range(_ceil_log2(T) + 1):
+        dist = dist + jnp.where(succ == tok, 0, dist[succ])
+        succ = succ[succ]
+    # pre-order position = dist(enter(root)) - dist(enter(v))
+    doc_pos = dist[ROOT] - dist[:M]
+
+    # ---- 10. Final masks and document orderings.
+    exists = valid & is_node_slot
+    tomb = deleted & exists
+    dead = dead & exists
+    visible = exists & ~tomb & ~dead
+    order_key = jnp.where(exists, doc_pos, IPOS)
+    _, order = lax.sort((order_key, jnp.arange(M, dtype=jnp.int32)),
+                        num_keys=1)
+    vis_key = jnp.where(visible, doc_pos, IPOS)
+    _, visible_order = lax.sort((vis_key, jnp.arange(M, dtype=jnp.int32)),
+                                num_keys=1)
+    doc_index = jnp.full(M, IPOS, jnp.int32).at[order].set(
+        jnp.arange(M, dtype=jnp.int32))
+    doc_index = jnp.where(exists, doc_index, IPOS)
+
+    # ---- 11. Sequential-parity statuses per op.
+    status = jnp.full(N, PAD, jnp.int8)
+    # adds
+    a_slot = op_slot
+    a_valid = valid[a_slot]
+    a_parent_ok = parent_ok[a_slot]
+    a_absorbed = a_valid & (anc_del[a_slot] < pos)
+    # an Add with ts 0 collides with the branch-head sentinel: the reference
+    # finds an existing child and reports AlreadyApplied
+    a_sentinel = ts <= 0
+    a_status = jnp.where(
+        a_sentinel | (a_valid & (op_is_dup | a_absorbed)), ALREADY_APPLIED,
+        jnp.where(a_valid, APPLIED,
+                  jnp.where(a_parent_ok & valid[pslot[a_slot]], NOT_FOUND,
+                            INVALID_PATH)))
+    status = jnp.where(is_add, a_status.astype(jnp.int8), status)
+    # deletes
+    dp_slot, dp_found = lookup(parent_ts)
+    d_parent_ok = (depth == 1) | ((depth >= 2) & dp_found & valid[dp_slot])
+    d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
+    d_repeat = d_ok & (del_pos[d_tslot] < pos)
+    d_target_later = d_ok & (node_pos[d_tslot] > pos)
+    # deleting a branch-head sentinel (ts 0) finds a tombstone: AlreadyApplied
+    d_sentinel = (ts == 0) & d_parent_ok
+    d_status = jnp.where(
+        d_sentinel | d_anc_absorbed | (d_repeat & ~d_target_later),
+        ALREADY_APPLIED,
+        jnp.where(d_ok & ~d_target_later, APPLIED,
+                  jnp.where(d_target_later | d_parent_ok, NOT_FOUND,
+                            INVALID_PATH)))
+    status = jnp.where(is_del, d_status.astype(jnp.int8), status)
+
+    return NodeTable(
+        ts=node_ts, parent=parent_eff, depth=node_depth,
+        value_ref=node_value_ref, paths=fp, exists=exists, tombstone=tomb,
+        dead=dead, visible=visible, doc_index=doc_index, order=order,
+        visible_order=visible_order,
+        num_nodes=jnp.sum(exists).astype(jnp.int32),
+        num_visible=jnp.sum(visible).astype(jnp.int32),
+        status=status)

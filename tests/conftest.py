@@ -2,12 +2,24 @@
 
 JAX tests run on a simulated 8-device CPU mesh so multi-chip sharding is
 exercised without TPU hardware (the driver separately dry-runs the multi-chip
-path; benches run on the real chip).  Must be set before jax initialises.
+path; benches run on the real chip).
+
+The environment force-registers a TPU PJRT plugin at interpreter start
+(sitecustomize) and pins ``JAX_PLATFORMS`` to it; plugin registration may
+also rewrite the platform list.  Tests must never touch the TPU tunnel —
+a concurrently running bench would deadlock on the device grant — so we both
+scrub the env and override the jax config explicitly before any backend
+initialises.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -67,6 +67,12 @@ def test_malformed_raises():
         json_codec.decode({"op": "add", "path": [1]})  # missing ts/val
 
 
+def test_malformed_batch_ops_field():
+    for bad in (None, 5, "x", {}):
+        with pytest.raises(json_codec.DecodeError):
+            json_codec.decode({"op": "batch", "ops": bad})
+
+
 def test_strict_types_match_reference_decoder():
     # Decode.int / Decode.list Decode.int reject these; so must we.
     with pytest.raises(json_codec.DecodeError):
