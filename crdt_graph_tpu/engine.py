@@ -1,0 +1,374 @@
+"""The TPU replica engine: an array-backed CRDTree.
+
+``TpuTree`` keeps the replica state the semilattice way: the state IS the
+operation set, and the tree is a materialised view produced by one batched
+kernel call (ops/merge.py).  Remote merge — the path BASELINE.json targets —
+is append + re-materialise, O(n log n) work with O(log n) parallel depth,
+instead of the reference's sequential per-op fold (CRDTree.elm:224-232,
+408-418).
+
+API parity: method names and semantics mirror the oracle ``CRDTree``
+(core/tree.py) — local edits stamp ``replica_id * 2**32 + counter``
+timestamps and move the cursor, remote ``apply`` does not move the cursor,
+``operations_since`` serves pull-based anti-entropy from the vector clock,
+idempotent redelivery is absorbed, and failing remote batches raise without
+mutating state (batch atomicity falls out of materialise-then-commit).
+Unlike the persistent oracle, ``TpuTree`` is a MUTABLE container (it's the
+server-side engine; snapshot with ``checkpoint``/``restore``).  The full
+node-traversal combinator API lives on the oracle; ``to_oracle()`` converts.
+
+Materialisation is lazy: edits mark the view dirty, reads re-materialise at
+most once per batch of edits.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .codec import packed as packed_mod
+from .codec.packed import DEFAULT_MAX_DEPTH, PackedOps
+from .core import operation as op_mod
+from .core import timestamp as ts_mod
+from .core.errors import InvalidPathError, NotFound, OperationFailedError
+from .core.operation import Add, Batch, Delete, Operation
+from .ops import merge as merge_mod
+from .ops import view as view_mod
+from .ops.merge import ALREADY_APPLIED, APPLIED, INVALID_PATH, NOT_FOUND, \
+    NodeTable
+
+
+class TpuTree:
+    """Array-backed replica.  See module docstring."""
+
+    def __init__(self, replica: int, max_depth: int = DEFAULT_MAX_DEPTH):
+        self._replica = replica
+        self._timestamp = ts_mod.make(replica, 0)
+        self._cursor: Tuple[int, ...] = (0,)
+        self._log: List[Operation] = []   # chronological, applied ops only
+        self._replicas: dict = {}
+        self._last_operation: Operation = Batch(())
+        self._max_depth = max_depth
+        self._table: Optional[NodeTable] = None
+        self._packed: Optional[PackedOps] = None
+
+    # -- identity / clocks (parity: CRDTree.elm:130-139, 337-350) ---------
+
+    @property
+    def replica_id(self) -> int:
+        return self._replica
+
+    @property
+    def timestamp(self) -> int:
+        return self._timestamp
+
+    @property
+    def cursor(self) -> Tuple[int, ...]:
+        return self._cursor
+
+    @property
+    def last_operation(self) -> Operation:
+        return self._last_operation
+
+    def next_timestamp(self) -> int:
+        return self._timestamp + 1
+
+    def last_replica_timestamp(self, replica: int) -> int:
+        return self._replicas.get(replica, 0)
+
+    # -- the materialised view -------------------------------------------
+
+    def table(self) -> NodeTable:
+        """The converged node table (host numpy); re-materialised lazily."""
+        if self._table is None:
+            self._packed = packed_mod.pack(self._log,
+                                           max_depth=self._max_depth)
+            self._table = view_mod.to_host(
+                merge_mod.materialize(self._packed.arrays()))
+        return self._table
+
+    def _invalidate(self) -> None:
+        self._table = None
+        self._packed = None
+
+    # -- remote application (parity: CRDTree.elm:235-295) -----------------
+
+    def apply(self, operation: Operation) -> "TpuTree":
+        """Apply a remote operation/batch atomically; cursor unmoved.
+
+        The whole candidate log is materialised once; per-op statuses decide
+        what enters the log (duplicates and edits under deleted branches are
+        absorbed).  Any NotFound/InvalidPath in the batch raises and leaves
+        the replica untouched — reference batch atomicity
+        (tests/CRDTreeTest.elm:482-498).
+        """
+        leaves = list(op_mod.iter_leaves(operation))
+        if not leaves:
+            self._last_operation = Batch(())
+            return self
+        p = packed_mod.concat(self._ensure_packed(),
+                              packed_mod.pack(leaves,
+                                              max_depth=self._max_depth))
+        table = view_mod.to_host(merge_mod.materialize(p.arrays()))
+        n0 = len(self._log)
+        st = np.asarray(table.status)[n0:n0 + len(leaves)]
+        failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
+        if failing.size:
+            # report the FIRST failing op in batch order, by its own error —
+            # the oracle stops there (CRDTree.elm:224-232)
+            k = int(failing[0])
+            if st[k] == NOT_FOUND:
+                raise OperationFailedError(leaves[k])
+            raise InvalidPathError(f"invalid path in {leaves[k]!r}")
+        applied = [op for op, s in zip(leaves, st) if s == APPLIED]
+        self._commit(applied, len(leaves) == len(applied), p, table)
+        self._last_operation = (
+            applied[0] if len(leaves) == 1 and applied
+            else Batch(tuple(applied)))
+        # the clock advances once per Add carrying our own replica id —
+        # including absorbed duplicates, and including Adds arriving through
+        # remote apply (reference: incrementTimestamp runs on the Ok path,
+        # CRDTree.elm:275-282, 318-319, 337-343)
+        own_adds = sum(1 for op in leaves
+                       if isinstance(op, Add)
+                       and ts_mod.replica_id(op.ts) == self._replica)
+        self._timestamp += own_adds
+        return self
+
+    def _commit(self, applied: List[Operation], all_applied: bool,
+                p: PackedOps, table: NodeTable) -> None:
+        for op in applied:
+            ts = op_mod.op_timestamp(op)
+            if ts is not None:
+                self._replicas[ts_mod.replica_id(ts)] = ts
+        self._log.extend(applied)
+        if applied:
+            if all_applied:
+                # candidate packing == new log packing: reuse the view
+                self._table, self._packed = table, p
+            else:
+                # absorbed ops sit in the candidate arrays but not in the
+                # log, so value_ref indices would skew — re-materialise from
+                # the log on next read
+                self._invalidate()
+        # else: view unchanged
+
+    # -- local edits (parity: CRDTree.elm:142-232) ------------------------
+
+    def add(self, value: Any) -> "TpuTree":
+        return self.add_after(self._cursor, value)
+
+    def add_after(self, path: Sequence[int], value: Any) -> "TpuTree":
+        op = Add(self.next_timestamp(), tuple(path), value)
+        self._apply_local(op)
+        return self
+
+    def add_branch(self, value: Any) -> "TpuTree":
+        self.add(value)
+        self._cursor = self._cursor + (0,)
+        return self
+
+    def delete(self, path: Sequence[int]) -> "TpuTree":
+        path = tuple(path)
+        prev_path = self._predecessor_path(path)
+        self._apply_local(Delete(path))
+        if self._slot_at(prev_path) is not None or prev_path == path:
+            self._cursor = prev_path
+        return self
+
+    def batch(self, funcs: Iterable[Callable[["TpuTree"], "TpuTree"]]
+              ) -> "TpuTree":
+        """Atomic local batch; accumulated last_operation like the oracle."""
+        saved = (list(self._log), self._timestamp, self._cursor,
+                 dict(self._replicas), self._last_operation)
+        acc: List[Operation] = []
+        try:
+            for f in funcs:
+                f(self)
+                acc.extend(op_mod.to_list(self._last_operation))
+        except Exception:
+            (self._log, self._timestamp, self._cursor,
+             self._replicas, self._last_operation) = saved
+            self._invalidate()
+            raise
+        self._last_operation = Batch(tuple(acc))
+        return self
+
+    def _apply_local(self, op: Operation) -> None:
+        saved_cursor = self._cursor
+        self.apply(op)
+        ts = op_mod.op_timestamp(op)
+        # cursor follows local edits (CRDTree.elm:298-316); absorbed ops
+        # leave it in place
+        if ts is not None and isinstance(op, (Add, Delete)):
+            if op_mod.to_list(self._last_operation):
+                self._cursor = tuple(op.path[:-1]) + (ts,)
+            else:
+                self._cursor = saved_cursor
+        # clock advancement happens in apply()
+
+    def _predecessor_path(self, path: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Predecessor for post-delete cursor placement, matching the
+        reference's search (CRDTree.elm:199-216): the first chain member
+        whose next-VISIBLE sibling is the target — i.e. the nearest visible
+        predecessor, or the first tombstone of a leading tombstone run, or
+        the target's own path when it heads the chain."""
+        table = self.table()
+        idx = self._slot_at(path)
+        doc = np.asarray(table.doc_index)
+        exists = np.asarray(table.exists)
+        depth = np.asarray(table.depth)
+        parent = np.asarray(table.parent)
+        visible = np.asarray(table.visible)
+        paths = np.asarray(table.paths)
+        tombstone = np.asarray(table.tombstone)
+        dead = np.asarray(table.dead)
+
+        def node_path(s: int) -> Tuple[int, ...]:
+            return tuple(int(x) for x in paths[s, :depth[s]])
+
+        if idx is not None and tombstone[idx] and not dead[idx]:
+            # tombstoned target: the reference probe (next-visible == target)
+            # never matches, cursor defaults to the target path
+            return path
+        if idx is None or dead[idx]:
+            # missing or dead target (oracle get() sees None either way): the
+            # reference falls back to the root branch and matches the first
+            # chain member with NO visible successor
+            mask = exists & (depth == 1)
+            sibs = np.nonzero(mask)[0]
+            sibs = sibs[np.argsort(doc[sibs])]
+            vis_idx = np.nonzero(visible[sibs])[0]
+            if vis_idx.size == 0:
+                return node_path(int(sibs[0])) if sibs.size else path
+            return node_path(int(sibs[int(vis_idx[-1])]))
+        # visible target: nearest visible predecessor in its branch, else the
+        # first tombstone of the leading run, else the target's own path
+        mask = exists & (parent == parent[idx]) & (depth == depth[idx])
+        sibs = np.nonzero(mask)[0]
+        sibs = sibs[np.argsort(doc[sibs])]
+        k = int(np.nonzero(sibs == idx)[0][0])
+        if k == 0:
+            return path
+        before = sibs[:k]
+        vis_before = before[visible[before]]
+        best = int(vis_before[-1]) if vis_before.size else int(before[0])
+        return node_path(best)
+
+    # -- anti-entropy (parity: CRDTree.elm:390-418) -----------------------
+
+    def operations_since(self, initial_timestamp: int) -> Operation:
+        if initial_timestamp == 0:
+            return op_mod.from_list(tuple(self._log))
+        return op_mod.from_list(
+            op_mod.since(initial_timestamp, list(reversed(self._log))))
+
+    # -- queries ----------------------------------------------------------
+
+    def _slot_at(self, path: Tuple[int, ...]) -> Optional[int]:
+        table = self.table()
+        d = len(path)
+        if d == 0 or d > self._max_depth:
+            return None
+        hit = np.nonzero(
+            np.asarray(table.exists) & (np.asarray(table.depth) == d) &
+            np.all(np.asarray(table.paths)[:, :d] ==
+                   np.asarray(path, dtype=np.int64), axis=1))[0]
+        return int(hit[0]) if hit.size else None
+
+    def get_value(self, path: Sequence[int]) -> Any:
+        """Value at path; None if missing, deleted, or under a deleted
+        branch."""
+        path = tuple(path)
+        idx = self._slot_at(path)
+        if idx is None:
+            return None
+        table = self.table()
+        if not bool(np.asarray(table.visible)[idx]):
+            return None
+        packed = self._ensure_packed()
+        return packed.values[int(np.asarray(table.value_ref)[idx])]
+
+    def _ensure_packed(self) -> PackedOps:
+        if self._packed is None:
+            self._packed = packed_mod.pack(self._log,
+                                           max_depth=self._max_depth)
+        return self._packed
+
+    def visible_values(self) -> List[Any]:
+        """Visible values in document order — the render path."""
+        table = self.table()
+        return view_mod.visible_values(table, self._ensure_packed().values)
+
+    def visible_paths(self) -> List[tuple]:
+        return view_mod.visible_paths(self.table())
+
+    def move_cursor_up(self) -> "TpuTree":
+        if len(self._cursor) > 1:
+            self._cursor = self._cursor[:-1]
+        return self
+
+    def set_cursor(self, path: Sequence[int]) -> "TpuTree":
+        path = tuple(path)
+        if self._slot_at(path) is None:
+            raise NotFound(f"no node at {path!r}")
+        self._cursor = path
+        return self
+
+    def __len__(self) -> int:
+        return int(self.table().num_visible)
+
+    def __repr__(self) -> str:
+        return (f"TpuTree(replica={self._replica}, ops={len(self._log)}, "
+                f"ts={self._timestamp})")
+
+    # -- interop / persistence -------------------------------------------
+
+    def to_oracle(self):
+        """Replay into a full-API oracle ``CRDTree`` (persistent value)."""
+        from .core.tree import CRDTree
+        tree = CRDTree.init(self._replica)
+        tree = tree.apply(self.operations_since(0))
+        return tree._replace(timestamp=self._timestamp,
+                             cursor=self._cursor)
+
+    def checkpoint(self, path: str) -> None:
+        """Persist the replica: the op log IS the checkpoint (reference
+        contract: full state = replay operationsSince 0, CRDTree.elm:235-262)
+        plus clocks and cursor.  Values must be JSON-encodable."""
+        from .codec import json_codec
+        import json
+        state = {
+            "replica": self._replica,
+            "timestamp": self._timestamp,
+            "cursor": list(self._cursor),
+            "replicas": {str(k): v for k, v in self._replicas.items()},
+            "log": json_codec.encode(Batch(tuple(self._log))),
+            "last_operation": json_codec.encode(self._last_operation),
+            "max_depth": self._max_depth,
+        }
+        with open(path, "w") as f:
+            json.dump(state, f)
+
+    @staticmethod
+    def restore(path: str) -> "TpuTree":
+        from .codec import json_codec
+        import json
+        with open(path) as f:
+            state = json.load(f)
+        tree = TpuTree(state["replica"], max_depth=state["max_depth"])
+        tree._log = list(json_codec.decode(state["log"]).ops)
+        tree._timestamp = state["timestamp"]
+        tree._cursor = tuple(state["cursor"])
+        tree._replicas = {int(k): v for k, v in state["replicas"].items()}
+        tree._last_operation = json_codec.decode(state["last_operation"])
+        return tree
+
+
+def init(replica: int, max_depth: int = DEFAULT_MAX_DEPTH) -> TpuTree:
+    """Build a TPU-engine replica (API parity with core.tree.init)."""
+    return TpuTree(replica, max_depth=max_depth)
+
+
+restore = TpuTree.restore

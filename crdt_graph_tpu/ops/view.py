@@ -53,14 +53,13 @@ def get_value(table: NodeTable, values: Sequence[Any],
               path: Sequence[int]) -> Any:
     """Value at a timestamp path; None for missing/deleted/dead nodes."""
     path = tuple(path)
-    paths = np.asarray(table.paths)
-    depths = np.asarray(table.depth)
-    refs = np.asarray(table.value_ref)
-    vis = np.asarray(table.visible)
     d = len(path)
-    match = (depths == d) & vis
-    idx = np.nonzero(match)[0]
-    for s in idx:
-        if tuple(paths[s, :d]) == path:
-            return values[refs[s]]
-    return None
+    if d == 0 or d > np.asarray(table.paths).shape[1]:
+        return None
+    hit = np.nonzero(
+        np.asarray(table.visible) & (np.asarray(table.depth) == d) &
+        np.all(np.asarray(table.paths)[:, :d] ==
+               np.asarray(path, dtype=np.int64), axis=1))[0]
+    if hit.size == 0:
+        return None
+    return values[int(np.asarray(table.value_ref)[hit[0]])]

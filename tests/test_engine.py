@@ -1,0 +1,208 @@
+"""TPU engine (array-backed replica) conformance: the same scenarios the
+oracle suite pins, driven through ``TpuTree``, plus engine-vs-oracle
+equivalence on randomized sessions and checkpoint/restore."""
+import random
+
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu import Add, Batch, Delete, engine
+from crdt_graph_tpu.core import operation as op_mod
+
+OFFSET = 2**32
+
+
+def test_local_editing_parity_with_oracle():
+    t = engine.init(0)
+    t.add("a").add("b").add_after([1], "z")
+    o = crdt.init(0).add("a").add("b").add_after([1], "z")
+    assert t.visible_values() == o.visible_values() == ["a", "z", "b"]
+    assert t.timestamp == o.timestamp
+    assert t.cursor == o.cursor
+    assert op_mod.to_list(t.operations_since(0)) == \
+        op_mod.to_list(o.operations_since(0))
+
+
+def test_add_branch_and_cursor():
+    t = engine.init(0).add_branch("a").add_branch("b")
+    assert t.cursor == (1, 2, 0)
+    t.add("c")
+    assert t.cursor == (1, 2, 3)
+    assert t.get_value([1, 2, 3]) == "c"
+    t.move_cursor_up()
+    assert t.cursor == (1, 2)
+
+
+def test_remote_apply_keeps_cursor_and_clock():
+    t = engine.init(2)
+    t.add("x")
+    cur, ts = t.cursor, t.timestamp
+    t.apply(Add(5 * OFFSET + 1, (0,), "r"))
+    assert t.cursor == cur and t.timestamp == ts
+    assert t.last_replica_timestamp(5) == 5 * OFFSET + 1
+
+
+def test_idempotent_redelivery():
+    t = engine.init(1)
+    t.add("a").add("b")
+    delta = t.operations_since(0)
+    peer = engine.init(2)
+    peer.apply(delta).apply(delta).apply(delta)
+    assert peer.visible_values() == ["a", "b"]
+    assert len(op_mod.to_list(peer.operations_since(0))) == 2
+
+
+def test_batch_atomicity_rolls_back():
+    t = engine.init(0)
+    t.add("a")
+    with pytest.raises(crdt.OperationFailedError):
+        t.apply(Batch((Add(7, (1,), "ok"), Add(8, (99,), "bad"))))
+    assert t.visible_values() == ["a"]
+    assert len(op_mod.to_list(t.operations_since(0))) == 1
+
+
+def test_delete_cursor_to_predecessor():
+    t = engine.init(0).add("a").add("b").add("c")
+    o = crdt.init(0).add("a").add("b").add("c")
+    t.delete([2])
+    o = o.delete([2])
+    assert t.cursor == o.cursor == (1,)
+    assert t.visible_values() == ["a", "c"]
+    # with b tombstoned, c's predecessor is the nearest VISIBLE node "a"
+    # (the reference probe skips tombstone runs, CRDTree.elm:199-216)
+    t.delete([3])
+    o = o.delete([3])
+    assert t.cursor == o.cursor == (1,)
+
+
+def test_double_delete_cursor_matches_oracle():
+    t = engine.init(0).add("a").add("b").add("c")
+    o = crdt.init(0).add("a").add("b").add("c")
+    t.delete([2])
+    o = o.delete([2])
+    t.delete([2])   # absorbed: target already a tombstone
+    o = o.delete([2])
+    assert t.cursor == o.cursor
+    assert t.visible_values() == o.visible_values()
+
+
+def test_delete_under_dead_branch_cursor_matches_oracle():
+    ops = Batch((Add(1, (0,), "a"), Add(2, (1, 0), "b"), Add(3, (1,), "c"),
+                 Delete((1,))))
+    t = engine.init(0)
+    t.apply(ops)
+    o = crdt.init(0).apply(ops)
+    t.delete([1, 2])   # child of deleted branch: absorbed
+    o = o.delete([1, 2])
+    assert t.cursor == o.cursor
+    assert t.visible_values() == o.visible_values()
+
+
+def test_batch_rollback_restores_last_operation():
+    t = engine.init(0).add("a")
+    before = t.last_operation
+
+    def boom(tree):
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError):
+        t.batch([lambda x: x.add("b"), boom])
+    assert t.last_operation == before
+    assert t.visible_values() == ["a"]
+
+
+def test_first_failing_op_decides_batch_error():
+    # invalid-path op precedes a not-found op: the first one wins, like the
+    # oracle's sequential stop
+    t = engine.init(0).add("a")
+    with pytest.raises(crdt.InvalidPathError):
+        t.apply(Batch((Add(7, (5, 6), "x"), Add(8, (99,), "y"))))
+    o = crdt.init(0).add("a")
+    with pytest.raises(crdt.InvalidPathError):
+        o.apply(Batch((Add(7, (5, 6), "x"), Add(8, (99,), "y"))))
+
+
+def test_operations_since_parity():
+    t = engine.init(0)
+    t.apply(Batch((Add(1, (0,), "a"), Add(2, (1,), "b"), Add(3, (2,), "c"),
+                   Delete((2,)))))
+    assert op_mod.to_list(t.operations_since(2)) == \
+        [Add(2, (1,), "b"), Add(3, (2,), "c"), Delete((2,))]
+    assert op_mod.to_list(t.operations_since(99)) == []
+
+
+def test_absorbed_ops_stay_out_of_log():
+    batch = Batch((Add(1, (0,), "a"), Delete((1,)), Add(2, (1, 0), "b")))
+    t = engine.init(0)
+    t.apply(batch)
+    o = crdt.init(0).apply(batch)
+    assert op_mod.to_list(t.operations_since(0)) == \
+        [Add(1, (0,), "a"), Delete((1,))]
+    assert t.visible_values() == o.visible_values() == []
+    # quirk preserved: the clock advanced for BOTH own-replica adds, the
+    # absorbed one included (reference Ok-no-op path)
+    assert t.timestamp == o.timestamp == 2
+    # the view after absorption must still resolve values correctly
+    t.add("c")
+    o2 = o.add("c")
+    assert t.visible_values() == o2.visible_values() == ["c"]
+
+
+def test_random_session_engine_equals_oracle():
+    rng = random.Random(42)
+    eng, orc = engine.init(3), crdt.init(3)
+    for step in range(60):
+        roll = rng.random()
+        if roll < 0.55:
+            v = rng.randrange(1000)
+            eng.add(v)
+            orc = orc.add(v)
+        elif roll < 0.7:
+            v = rng.randrange(1000)
+            eng.add_branch(v)
+            orc = orc.add_branch(v)
+        elif roll < 0.85 and len(orc.visible_values()) > 0:
+            paths = []
+            orc.walk(lambda n, acc: (crdt.TAKE, acc.append(n.path) or acc),
+                     paths)
+            p = rng.choice(paths)
+            eng.delete(p)
+            orc = orc.delete(p)
+        else:
+            # remote traffic interleaved
+            ts = 9 * OFFSET + step + 1
+            op = Add(ts, (0,), f"r{step}")
+            eng.apply(op)
+            orc = orc.apply(op)
+        assert eng.cursor == orc.cursor
+    assert eng.visible_values() == orc.visible_values()
+    assert eng.timestamp == orc.timestamp
+    assert op_mod.to_list(eng.operations_since(0)) == \
+        op_mod.to_list(orc.operations_since(0))
+
+
+def test_to_oracle_round_trip():
+    t = engine.init(1).add("a").add_branch("b")
+    t.add("c")
+    o = t.to_oracle()
+    assert o.visible_values() == t.visible_values()
+    assert o.cursor == t.cursor
+    assert o.timestamp == t.timestamp
+
+
+def test_checkpoint_restore(tmp_path):
+    t = engine.init(7)
+    t.add("a").add("b").delete([7 * OFFSET + 1])
+    f = str(tmp_path / "ckpt.json")
+    t.checkpoint(f)
+    back = engine.restore(f)
+    assert back.visible_values() == t.visible_values() == ["b"]
+    assert back.timestamp == t.timestamp
+    assert back.cursor == t.cursor
+    assert back.last_replica_timestamp(7) == t.last_replica_timestamp(7)
+    # restored replica keeps editing exactly like the oracle would: the
+    # cursor sits on the deleted node's tombstone, so the new node lands
+    # before "b" (higher ts closer to that anchor)
+    back.add("c")
+    o = crdt.init(7).add("a").add("b").delete([7 * OFFSET + 1]).add("c")
+    assert back.visible_values() == o.visible_values() == ["c", "b"]
