@@ -22,7 +22,6 @@ most once per batch of edits.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,8 +34,7 @@ from .core.errors import InvalidPathError, NotFound, OperationFailedError
 from .core.operation import Add, Batch, Delete, Operation
 from .ops import merge as merge_mod
 from .ops import view as view_mod
-from .ops.merge import ALREADY_APPLIED, APPLIED, INVALID_PATH, NOT_FOUND, \
-    NodeTable
+from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
 
 
 class TpuTree:
@@ -182,6 +180,9 @@ class TpuTree:
         """Atomic local batch; accumulated last_operation like the oracle."""
         saved = (list(self._log), self._timestamp, self._cursor,
                  dict(self._replicas), self._last_operation)
+        # a func that edits nothing must contribute nothing — the oracle
+        # resets the accumulator before folding (core/tree.py batch)
+        self._last_operation = Batch(())
         acc: List[Operation] = []
         try:
             for f in funcs:
@@ -267,12 +268,16 @@ class TpuTree:
     # -- queries ----------------------------------------------------------
 
     def _slot_at(self, path: Tuple[int, ...]) -> Optional[int]:
+        """Slot of the node at ``path`` — tombstones included, discarded
+        descendants of deleted branches excluded, matching the oracle's
+        ``get`` (a tombstone's children leave the tree, core/tree.py:195)."""
         table = self.table()
         d = len(path)
         if d == 0 or d > self._max_depth:
             return None
         hit = np.nonzero(
-            np.asarray(table.exists) & (np.asarray(table.depth) == d) &
+            np.asarray(table.exists) & ~np.asarray(table.dead) &
+            (np.asarray(table.depth) == d) &
             np.all(np.asarray(table.paths)[:, :d] ==
                    np.asarray(path, dtype=np.int64), axis=1))[0]
         return int(hit[0]) if hit.size else None
@@ -280,15 +285,8 @@ class TpuTree:
     def get_value(self, path: Sequence[int]) -> Any:
         """Value at path; None if missing, deleted, or under a deleted
         branch."""
-        path = tuple(path)
-        idx = self._slot_at(path)
-        if idx is None:
-            return None
-        table = self.table()
-        if not bool(np.asarray(table.visible)[idx]):
-            return None
-        packed = self._ensure_packed()
-        return packed.values[int(np.asarray(table.value_ref)[idx])]
+        return view_mod.get_value(self.table(), self._ensure_packed().values,
+                                  path)
 
     def _ensure_packed(self) -> PackedOps:
         if self._packed is None:

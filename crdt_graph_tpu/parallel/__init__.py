@@ -1,0 +1,8 @@
+"""Multi-chip scale-out: device meshes and sharded merge entry points."""
+from .mesh import (DOCS_AXIS, OPS_AXIS, batched_materialize, make_mesh,
+                   sharded_materialize, stack_packed)
+
+__all__ = [
+    "DOCS_AXIS", "OPS_AXIS", "batched_materialize", "make_mesh",
+    "sharded_materialize", "stack_packed",
+]

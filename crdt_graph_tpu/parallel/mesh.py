@@ -1,0 +1,150 @@
+"""Multi-chip scale-out for the merge kernel: meshes, shardings, collectives.
+
+The reference distributes by shipping JSON op batches between replicas over
+an application-provided network (CRDTree/Operation.elm:109-159, README.md:
+20-22).  Here "the network" is the TPU interconnect: op arrays live sharded
+over a ``jax.sharding.Mesh`` and the collectives XLA inserts for the merge
+kernel's sorts and gathers ride ICI/DCN.
+
+Two orthogonal mesh axes, composable into a 2-D mesh:
+
+- ``docs`` — data parallelism over independent documents (trees).  A server
+  merging many documents batches them on a leading axis and shards that axis;
+  merges never communicate across documents, so scaling is linear.  This is
+  the realistic serving axis (each collaborative document is independent).
+- ``ops`` — parallelism *within* one merge: the packed op axis is sharded, so
+  each chip holds a slice of the operation set (e.g. the logs of a subset of
+  replicas, concatenated: the semilattice join is insensitive to how ops are
+  distributed).  The kernel is expressed as whole-array ``lax`` ops
+  (sort/scatter/gather); partitioning is delegated to XLA's SPMD partitioner
+  via input shardings — the idiomatic JAX recipe (mesh → shardings → let XLA
+  insert all-to-alls/all-gathers) rather than hand-written per-chip message
+  passing.
+
+Entry points:
+
+- :func:`make_mesh` — build a 1-D or 2-D device mesh.
+- :func:`sharded_materialize` — one merge, op axis sharded over ``ops``.
+- :func:`batched_materialize` — B independent merges, vmapped on a leading
+  doc axis, sharded over ``docs`` (and optionally ``ops``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..codec.packed import PackedOps
+from ..ops import merge as merge_mod
+from ..ops.merge import NodeTable
+
+DOCS_AXIS = "docs"
+OPS_AXIS = "ops"
+
+
+def make_mesh(n_docs: int = 1, n_ops: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A ``(docs, ops)`` mesh over ``n_docs * n_ops`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_docs * n_ops
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_docs, n_ops)
+    return Mesh(grid, (DOCS_AXIS, OPS_AXIS))
+
+
+def _pad_ops_to(ops: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
+    """Pad the op axis to length ``n`` (pad rows are KIND_PAD zeros)."""
+    cur = ops["kind"].shape[0]
+    if cur == n:
+        return dict(ops)
+    if cur > n:
+        raise ValueError(f"op count {cur} exceeds target {n}")
+    out = {}
+    for k, v in ops.items():
+        pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
+        if k == "kind":
+            out[k] = np.pad(v, pad_width, constant_values=2)  # KIND_PAD
+        elif k == "value_ref":
+            out[k] = np.pad(v, pad_width, constant_values=-1)
+        elif k == "pos":
+            out[k] = np.concatenate(
+                [v, np.arange(cur, n, dtype=v.dtype)])
+        else:
+            out[k] = np.pad(v, pad_width)
+    return out
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
+    """One merge with the op axis sharded over the mesh's ``ops`` axis.
+
+    The op arrays are padded to a multiple of the axis size, placed with
+    ``NamedSharding(mesh, P(OPS_AXIS))``, and the stock kernel is jitted with
+    those input shardings; XLA partitions the sorts and scatter/gathers and
+    inserts the ICI collectives.  The resulting table is replicated (every
+    chip holds the converged tree — which is what a serving replica wants).
+    """
+    n_ops = mesh.shape[OPS_AXIS]
+    n = round_up(ops["kind"].shape[0], n_ops)
+    padded = _pad_ops_to(ops, n)
+
+    def run():
+        # device_put must sit inside the x64 scope: outside it JAX silently
+        # downcasts int64 host arrays to int32, truncating timestamps
+        device_ops = {k: jax.device_put(v, NamedSharding(mesh, P(OPS_AXIS)))
+                      for k, v in padded.items()}
+        return merge_mod.materialize(device_ops)
+
+    if jax.config.jax_enable_x64:
+        return run()
+    with jax.enable_x64(True):
+        return run()
+
+
+def _batched_kernel(ops: Dict[str, jax.Array]) -> NodeTable:
+    return jax.vmap(merge_mod._materialize.__wrapped__)(ops)
+
+
+def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
+                        shard_ops_axis: bool = False) -> NodeTable:
+    """B independent merges: arrays carry a leading document axis ``[B, N]``.
+
+    The doc axis is sharded over ``docs`` — embarrassingly parallel, linear
+    scaling (the serving path: many documents, one merge each).  With
+    ``shard_ops_axis`` the op axis is additionally sharded over ``ops`` for
+    2-D parallelism on large per-document batches.
+    """
+    n_docs = mesh.shape[DOCS_AXIS]
+    b = ops["kind"].shape[0]
+    if b % n_docs != 0:
+        raise ValueError(f"doc axis {b} not divisible by mesh docs axis "
+                         f"{n_docs}; pad the document batch")
+    op_spec = (OPS_AXIS,) if shard_ops_axis else (None,)
+
+    def spec_for(v: np.ndarray) -> P:
+        return P(DOCS_AXIS, *op_spec[:max(0, v.ndim - 1)])
+
+    def run():
+        device_ops = {k: jax.device_put(v, NamedSharding(mesh, spec_for(v)))
+                      for k, v in ops.items()}
+        return jax.jit(_batched_kernel)(device_ops)
+
+    if jax.config.jax_enable_x64:
+        return run()
+    with jax.enable_x64(True):
+        return run()
+
+
+def stack_packed(batches: Sequence[PackedOps]) -> Dict[str, np.ndarray]:
+    """Stack per-document packed ops into ``[B, N]`` arrays (N = max,
+    pad-extended) for :func:`batched_materialize`."""
+    n = max(p.capacity for p in batches)
+    per = [_pad_ops_to(p.arrays(), n) for p in batches]
+    return {k: np.stack([d[k] for d in per]) for k in per[0]}

@@ -206,3 +206,28 @@ def test_checkpoint_restore(tmp_path):
     back.add("c")
     o = crdt.init(7).add("a").add("b").delete([7 * OFFSET + 1]).add("c")
     assert back.visible_values() == o.visible_values() == ["c", "b"]
+
+
+def test_batch_with_non_editing_func_matches_oracle():
+    # a cursor-only func must not leak the pre-batch last_operation into
+    # the accumulated batch (oracle resets the accumulator first)
+    t = engine.init(0)
+    t.add("a")
+    t.batch([lambda x: x.move_cursor_up()])
+    o = crdt.init(0).add("a").batch([lambda x: x.move_cursor_up()])
+    assert t.last_operation == o.last_operation == Batch(())
+
+
+def test_set_cursor_rejects_dead_nodes_like_oracle():
+    ops = Batch((Add(1, (0,), "a"), Add(2, (1, 0), "b"), Delete((1,))))
+    t = engine.init(9)
+    t.apply(ops)
+    o = crdt.init(9).apply(ops)
+    # tombstoned node itself remains addressable (reference get finds it)
+    t.set_cursor((1,))
+    o.set_cursor((1,))
+    # its discarded descendant is not
+    with pytest.raises(crdt.NotFound):
+        t.set_cursor((1, 2))
+    with pytest.raises(crdt.NotFound):
+        o.set_cursor((1, 2))
