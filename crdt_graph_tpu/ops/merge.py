@@ -43,9 +43,35 @@ every node, first its child branch's T* roots (group 0), then its
 same-branch T* children (group 1), each group timestamp-descending.
 Pre-order ranks are computed without recursion by building the Euler tour of
 this forest (enter/exit token per node, successor pointers from one sibling
-sort) and running Wyllie pointer-doubling list ranking — ⌈log2(2M)⌉ gather
-passes.  The nearest-smaller-ancestor chase is O(log N) pointer-halving
-rounds.
+sort) and list-ranking it.
+
+TPU-shaped engineering (the difference between this and a naive lax
+translation — v5e has no native int64 and random HBM gathers are the
+bottleneck):
+
+- **One 64-bit sort, then dense int32 slots.**  Timestamps are sorted once
+  as (hi, lo) int32 key pairs; every downstream comparison uses the dense
+  slot ids, whose order IS timestamp order.  No int64 feeds a sort, a
+  gather, or a pointer loop after step 1.
+- **Path validation by polynomial hashing.**  "Claimed prefix == parent's
+  materialised path" (what the reference's recursive descent checks,
+  Internal/Node.elm:138-163) compares D-element int64 rows; done literally
+  it gathers [M, D] rows twice.  Instead each op's claimed path is hashed
+  (elementwise, no gather) and compared against the parent's full-path
+  hash — one [M] gather.  Hashes are 64-bit polynomial; a false accept
+  needs a 2^-64 collision against a malformed concurrent path.
+- **Fixpoint loops exit early.**  Validity cascading, tombstone-subtree
+  propagation and the nearest-smaller-ancestor chase are pointer-doubling
+  loops that need their worst-case O(log N) trips only for adversarial
+  chains; on causal logs they converge in 0-2 trips.  Each runs as a
+  ``lax.while_loop`` with a convergence test and a static trip cap.
+- **Run-contracted list ranking.**  The Euler tour of real op logs is
+  dominated by ±1-stride index runs (insertion chains produce consecutive
+  slots whose tour tokens chain consecutively).  Maximal runs are detected
+  elementwise, contracted by a prefix-sum, and Wyllie pointer-doubling runs
+  on the *contracted* list — O(log #runs) trips instead of O(log 2M); ranks
+  expand back elementwise.  A 64-chain million-op merge contracts to a few
+  hundred list elements.
 
 Deletes tombstone a node and kill its whole subtree (a tombstone's children
 are discarded, Internal/Node.elm:237-238); tombstones keep their list
@@ -68,14 +94,13 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from functools import partial
 from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..codec.packed import KIND_ADD, KIND_DELETE, KIND_PAD, MAX_TS
+from ..codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
 
 # Per-op result statuses (sequential parity; see module docstring).
 APPLIED = 0
@@ -87,6 +112,9 @@ PAD = 4
 BIG = MAX_TS          # sorts-after-everything timestamp sentinel (python int:
                       # promotes against int64 arrays without x64-mode issues)
 IPOS = 2**31 - 1      # "no position" / +inf for int32 positions
+
+# 64-bit polynomial-hash base for path validation (odd ⇒ invertible mod 2^64)
+HASH_P = 0x9E3779B97F4A7C15
 
 
 @jax.tree_util.register_dataclass
@@ -130,18 +158,55 @@ def _ceil_log2(n: int) -> int:
     return max(1, math.ceil(math.log2(max(2, n))))
 
 
-def materialize(ops: Dict[str, jax.Array]) -> NodeTable:
-    """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
+def _split_ts(t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """int64 timestamp → (hi, lo) int32 sort keys, order-preserving.
 
-    Timestamps are int64, so the kernel requires 64-bit mode; if the host
-    program runs JAX in default x32 mode, tracing and input conversion are
-    scoped inside ``jax.enable_x64`` rather than flipping the process-global
-    flag.
+    ts < 2^62, so hi = ts >> 32 < 2^30 fits int32 (BIG maps to 2^30); the
+    low half is biased into signed range.
     """
-    if jax.config.jax_enable_x64:
-        return _materialize(ops)
-    with jax.enable_x64(True):
-        return _materialize(ops)
+    hi = (t >> 32).astype(jnp.int32)
+    lo = ((t & 0xFFFFFFFF) - 2**31).astype(jnp.int32)
+    return hi, lo
+
+
+def _fix_and(ok: jax.Array, ptr: jax.Array, cap: int) -> jax.Array:
+    """AND of ``ok`` over every ancestor along ``ptr`` chains (terminal
+    slots self-loop).  Pointer doubling with early exit: 0 trips when all
+    ok, log(chain depth) when something is invalid.  The static ``cap``
+    guarantees termination even on adversarial pointer cycles, which
+    doubling never collapses to self-loops."""
+    def cond(state):
+        ok, _, live, i = state
+        return live & (i < cap) & jnp.any(~ok)
+
+    def body(state):
+        ok, ptr, _, i = state
+        ok2 = ok & ok[ptr]
+        ptr2 = ptr[ptr]
+        return ok2, ptr2, jnp.any(ptr2 != ptr), i + 1
+
+    ok, _, _, _ = lax.while_loop(
+        cond, body, (ok, ptr, jnp.array(True), jnp.int32(0)))
+    return ok
+
+
+def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
+             cap: int) -> jax.Array:
+    """MIN of ``val`` over self + every ancestor along ``ptr`` chains.
+    Skipped entirely when ``active`` is false (no deletes in the batch)."""
+    def cond(state):
+        _, _, live, i = state
+        return live & (i < cap)
+
+    def body(state):
+        val, ptr, _, i = state
+        val2 = jnp.minimum(val, val[ptr])
+        ptr2 = ptr[ptr]
+        return val2, ptr2, jnp.any(ptr2 != ptr), i + 1
+
+    val, _, _, _ = lax.while_loop(
+        cond, body, (val, ptr, active, jnp.int32(0)))
+    return val
 
 
 @jax.jit
@@ -160,29 +225,46 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     M = N + 2
     ROOT = 0
     NULL = M - 1
+    slot_ids = jnp.arange(M, dtype=jnp.int32)
 
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
 
-    # ---- 1. Sort adds by (ts, pos); first arrival of a timestamp wins
-    # (idempotence, Internal/Node.elm:63-65).  Non-adds sink to the end.
+    # ---- 1. Sort adds by (ts, pos) as int32 key triples; first arrival of
+    # a timestamp wins (idempotence, Internal/Node.elm:63-65).  Non-adds
+    # sink to the end.  This is the only timestamp-keyed sort; after it,
+    # slot ids are dense int32 ranks whose order IS timestamp order.
     sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
-    sorted_ts, sorted_pos, sorted_idx = lax.sort(
-        (sort_ts, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+    ts_hi, ts_lo = _split_ts(sort_ts)
+    s_hi, s_lo, sorted_pos, sorted_idx = lax.sort(
+        (ts_hi, ts_lo, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
+    sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
+        (s_lo.astype(jnp.int64) + 2**31)
     run_start = jnp.concatenate(
-        [jnp.ones(1, bool), sorted_ts[1:] != sorted_ts[:-1]])
-    is_canon = run_start & (sorted_ts < BIG)
+        [jnp.ones(1, bool),
+         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+    not_big = s_hi < (BIG >> 32)
+    is_canon = run_start & not_big
     # slot of the run's canonical add = run-start index + 1
     canon_pos = lax.cummax(jnp.where(run_start,
                                      jnp.arange(N, dtype=jnp.int32), 0))
     slot_of_sorted = canon_pos + 1
     # per-op: node slot and duplicate flag (original batch order)
     op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
-        jnp.where(sorted_ts < BIG, slot_of_sorted, NULL))
-    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
-        ~run_start & (sorted_ts < BIG))
+        jnp.where(not_big, slot_of_sorted, NULL))
+    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(~run_start & not_big)
 
-    # ---- 2. Scatter canonical adds into the node table (slots 1..N).
+    # ---- 2. Path hashes (elementwise — replaces [M, D] row gathers).
+    ppow = jnp.asarray(
+        [pow(HASH_P, i, 2**64) for i in range(D)], dtype=jnp.uint64)
+    terms = paths.astype(jnp.uint64) * ppow[None, :]
+    cols = jnp.arange(D, dtype=jnp.int32)[None, :]
+    # claimed anchor path = first depth-1 elements; full path = all depth
+    h_claim_op = jnp.sum(
+        jnp.where(cols < depth[:, None] - 1, terms, 0), axis=1)
+    h_full_op = jnp.sum(jnp.where(cols < depth[:, None], terms, 0), axis=1)
+
+    # ---- 3. Scatter canonical adds into the node table (slots 1..N).
     tgt = jnp.where(is_canon, slot_of_sorted, NULL)
 
     def scat(init, vals, at=tgt):
@@ -191,68 +273,78 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     g = lambda a: a[sorted_idx]  # noqa: E731  original-order field, sorted
     node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
         .at[NULL].set(BIG)
-    node_parent_ts = scat(jnp.zeros(M, jnp.int64), g(parent_ts))
-    node_anchor_ts = scat(jnp.zeros(M, jnp.int64), g(anchor_ts))
     node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
     node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
     node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
     node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
         paths[sorted_idx], mode="drop")
+    node_h_claim = scat(jnp.zeros(M, jnp.uint64), g(h_claim_op))
     is_node_slot = scat(jnp.zeros(M, bool), is_canon)
 
     # Full materialised path: claimed anchor path with the node's own ts in
-    # the last position (Internal/Node.elm:79-82).
+    # the last position (Internal/Node.elm:79-82); its hash extends the
+    # claimed hash by one term.
     col = jnp.clip(node_depth - 1, 0, D - 1)
-    fp = node_claimed.at[jnp.arange(M), col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[jnp.arange(M), col]))
+    fp = node_claimed.at[slot_ids, col].set(
+        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]))
+    node_h_full = jnp.where(
+        node_depth > 0,
+        node_h_claim + node_ts.astype(jnp.uint64) * ppow[col], 0)
 
-    # ---- 3. Timestamp → slot lookup over the sorted add axis.
-    def lookup(q: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        idx = jnp.searchsorted(sorted_ts, q, side="left").astype(jnp.int32)
-        idx_c = jnp.minimum(idx, N - 1)
-        hit = (sorted_ts[idx_c] == q) & (q > 0) & (q < BIG)
-        slot = jnp.where(q == 0, ROOT, jnp.where(hit, idx_c + 1, NULL))
-        return slot, (q == 0) | hit
+    # ---- 4. Timestamp → slot lookups, batched into ONE searchsorted over
+    # the sorted add axis (queries: per-slot parent & anchor, per-op delete
+    # target & delete parent).
+    queries = jnp.concatenate([
+        scat(jnp.zeros(M, jnp.int64), g(parent_ts)),    # node parent ts
+        scat(jnp.zeros(M, jnp.int64), g(anchor_ts)),    # node anchor ts
+        ts,                                             # delete target ts
+        parent_ts,                                      # delete parent ts
+    ])
+    qidx = jnp.searchsorted(sorted_ts, queries, side="left").astype(jnp.int32)
+    qidx_c = jnp.minimum(qidx, N - 1)
+    qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & (queries < BIG)
+    qslot = jnp.where(queries == 0, ROOT,
+                      jnp.where(qhit, qidx_c + 1, NULL))
+    qfound = (queries == 0) | qhit
+    pslot, aslot = qslot[:M], qslot[M:2 * M]
+    pfound, afound = qfound[:M], qfound[M:2 * M]
+    d_tslot, dp_slot = qslot[2 * M:2 * M + N], qslot[2 * M + N:]
+    d_tfound, dp_found = qfound[2 * M:2 * M + N], qfound[2 * M + N:]
+    pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
+    node_anchor_is_sentinel = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
 
-    # ---- 4. Resolve parents/anchors; local validity per node slot.
-    pslot, pfound = lookup(node_parent_ts)
-    pslot = jnp.where(jnp.arange(M) == ROOT, ROOT, pslot)
-    aslot, afound = lookup(node_anchor_ts)
-
-    # claimed prefix (first depth-1 elements) must equal the parent's full
-    # path — this is what "descending the path" validates in the reference
-    # (Internal/Node.elm:138-163).
-    dmask = jnp.arange(D)[None, :] < (node_depth[:, None] - 1)
-    prefix_ok = jnp.all(jnp.where(dmask, node_claimed == fp[pslot], True),
-                        axis=1)
+    # ---- 5. Local validity per node slot: the claimed prefix must hash-
+    # match the parent's materialised path (what "descending the path"
+    # validates in the reference, Internal/Node.elm:138-163), the anchor
+    # must be a sibling (same parent), depths must chain.
+    prefix_ok = node_h_claim == node_h_full[pslot]
     depth_ok = (node_depth >= 1) & (node_depth <= D) & \
         (node_depth == node_depth[pslot] + 1)
     parent_ok = pfound & depth_ok & prefix_ok
-    sentinel_anchor = node_anchor_ts == 0
-    anchor_ok = sentinel_anchor | (afound & (pslot[aslot] == pslot) &
-                                   (aslot != ROOT))
+    anchor_ok = node_anchor_is_sentinel | \
+        (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
     local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
     local_ok = local_ok.at[ROOT].set(True)
 
-    # ---- 5. Validity cascades along the order forest: a node exists only if
-    # its anchor chain and tree ancestors all exist.
-    order_parent = jnp.where(sentinel_anchor, pslot, aslot)
+    # ---- 6. Validity cascades along the anchor forest: a node exists only
+    # if its anchor chain and tree ancestors all exist.  Parked slots are
+    # masked "ok" during the cascade so the all-ops-valid fast path exits in
+    # zero trips (no valid node's chain depends on a parked slot: pointing
+    # at one implies pfound/afound already failed), then masked back out.
+    order_parent = jnp.where(node_anchor_is_sentinel, pslot, aslot)
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
-    ok, ptr = local_ok, order_parent
-    for _ in range(_ceil_log2(M) + 1):
-        ok = ok & ok[ptr]
-        ptr = ptr[ptr]
-    valid = ok
+    cascade_ok = _fix_and(local_ok | ~is_node_slot, order_parent,
+                          _ceil_log2(M) + 1)
+    valid = cascade_ok & is_node_slot
+    valid = valid.at[ROOT].set(True)
     # canonical parent pointer for existing nodes; root for itself
     parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
 
-    # ---- 6. Deletes: tombstone valid targets (first delete per target wins
-    # the log; the tree flag is an idempotent OR either way).
-    d_tslot, d_tfound = lookup(ts)
+    # ---- 7. Deletes: tombstone valid targets (first delete per target wins
+    # the log; the tree flag is an idempotent OR either way).  Target match
+    # checks the full path by hash.
     d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
-    d_dmask = jnp.arange(D)[None, :] < depth[:, None]
-    d_path_ok = jnp.all(jnp.where(d_dmask, paths == fp[d_tslot], True),
-                        axis=1)
+    d_path_ok = h_full_op == node_h_full[d_tslot]
     d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
         d_depth_ok & d_path_ok
     d_tgt = jnp.where(d_ok, d_tslot, NULL)
@@ -260,44 +352,56 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
         .at[NULL].set(IPOS)
 
-    # ---- 7. Dead-subtree propagation down tree-parent chains (delete
+    # ---- 8. Dead-subtree propagation down tree-parent chains (delete
     # discards descendants, Internal/Node.elm:237-238).  Also carries the
-    # earliest ancestor-delete position for absorption statuses.
+    # earliest ancestor-delete position for absorption statuses.  Skipped
+    # when the batch has no effective delete.
     anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
-    jmp = parent_eff
-    for _ in range(_ceil_log2(D) + 1):
-        anc_del = jnp.minimum(anc_del, anc_del[jmp])
-        jmp = jmp[jmp]
+    anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
+                       _ceil_log2(D) + 1)
     dead = valid & (anc_del < IPOS)
 
-    # ---- 8. The order forest: each node's T* parent is the nearest node on
+    # ---- 9. The order forest: each node's T* parent is the nearest node on
     # its within-branch anchor chain with a SMALLER timestamp (-1 = chain
-    # exhausted at the branch head).  Pointer-halving chase: when the current
-    # candidate m has ts > ours, everything m itself skipped is > ts(m) > ours,
-    # so jumping to m's own candidate skips no answer of ours.
+    # exhausted at the branch head).  Slot ids compare like timestamps, so
+    # the chase is pure int32.  Pointer-halving: when the current candidate
+    # m has a larger slot than ours, everything m itself skipped is > m >
+    # us, so jumping to m's own candidate skips no answer of ours.  On
+    # causal logs anchors are older than their nodes (smaller ts) and the
+    # loop exits in 0 trips.
     in_forest = valid & is_node_slot
-    mptr = jnp.where(sentinel_anchor | ~in_forest, -1, aslot)
-    for _ in range(_ceil_log2(M) + 1):
+    mptr0 = jnp.where(node_anchor_is_sentinel | ~in_forest, -1, aslot)
+
+    nsv_cap = _ceil_log2(M) + 2
+
+    def nsv_cond(state):
+        mptr, i = state
+        return (i < nsv_cap) & jnp.any((mptr >= 0) & (mptr > slot_ids))
+
+    def nsv_body(state):
+        mptr, i = state
         m = jnp.where(mptr >= 0, mptr, NULL)
-        unresolved = (mptr >= 0) & (node_ts[m] > node_ts)
-        mptr = jnp.where(unresolved, mptr[m], mptr)
+        unresolved = (mptr >= 0) & (mptr > slot_ids)
+        return jnp.where(unresolved, mptr[m], mptr), i + 1
+
+    mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
     star_parent = jnp.where(mptr >= 0, mptr, pslot)
     star_sentinel = mptr < 0
 
     # Sibling sort → Euler-tour successor pointers.  Children of p: child-
     # branch T* roots first (group 0), then same-branch T* children (group
     # 1); each group timestamp-DESCENDING (the RGA rule: higher timestamp
-    # closer to the anchor).
+    # closer to the anchor) — slot-descending, int32 keys only.
     order_parent = jnp.where(in_forest, star_parent, order_parent)
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
     skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
-    neg_ts = jnp.where(in_forest, -node_ts, BIG)
+    neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
     s_parent, _, _, s_slot = lax.sort(
-        (skey, ggrp, neg_ts, jnp.arange(M, dtype=jnp.int32)), num_keys=3)
+        (skey, ggrp, neg_slot, slot_ids), num_keys=3)
     same_parent = s_parent[1:] == s_parent[:-1]
-    # next sibling within the concatenated child list; the root never sits in
-    # a sibling list (its exit token is the chain terminal below)
+    # next sibling within the concatenated child list; the root never sits
+    # in a sibling list (its exit token is the chain terminal below)
     sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
         jnp.where(same_parent, s_slot[1:], -1)).at[ROOT].set(-1)
     # first child of each parent = slot at every parent-run start
@@ -306,42 +410,104 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
         s_slot, mode="drop").at[NULL].set(-1)
 
-    # Tokens: enter(v) = v, exit(v) = M + v.  succ forms chains ending in the
-    # self-loop at exit(root); parked tokens (invalid slots) never feed real
-    # chains, so their ranks are garbage that is masked out below.
+    # ---- 10. Euler tour: enter(v) = token v, exit(v) = token M + v.
+    # Successors form one chain per tree ending in the self-loop at
+    # exit(root); tokens of parked (invalid) slots self-loop in isolation so
+    # the run detector below ignores them.
     T = 2 * M
     tok = jnp.arange(T, dtype=jnp.int32)
-    enter_succ = jnp.where(first_child >= 0, first_child,
-                           M + jnp.arange(M, dtype=jnp.int32))
-    up = jnp.where(order_parent == jnp.arange(M), M + jnp.arange(M),
-                   M + order_parent)
-    exit_succ = jnp.where(sib_next >= 0, sib_next, up)
+    in_tour = in_forest.at[ROOT].set(True)
+    enter_succ = jnp.where(
+        ~in_tour, slot_ids,
+        jnp.where(first_child >= 0, first_child, M + slot_ids))
+    up = jnp.where(order_parent == slot_ids, M + slot_ids, M + order_parent)
+    exit_succ = jnp.where(
+        ~in_tour, M + slot_ids,
+        jnp.where(sib_next >= 0, sib_next, up))
     succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
 
-    # ---- 9. Wyllie list ranking: distance to each chain's terminal.
-    dist = jnp.where(succ == tok, 0, 1).astype(jnp.int32)
-    for _ in range(_ceil_log2(T) + 1):
-        dist = dist + jnp.where(succ == tok, 0, dist[succ])
-        succ = succ[succ]
-    # pre-order position = dist(enter(root)) - dist(enter(v))
-    doc_pos = dist[ROOT] - dist[:M]
-
-    # ---- 10. Final masks and document orderings.
+    # ---- 11. Masks (the ranking below counts them as token weights).
     exists = valid & is_node_slot
     tomb = deleted & exists
     dead = dead & exists
     visible = exists & ~tomb & ~dead
-    order_key = jnp.where(exists, doc_pos, IPOS)
-    _, order = lax.sort((order_key, jnp.arange(M, dtype=jnp.int32)),
-                        num_keys=1)
-    vis_key = jnp.where(visible, doc_pos, IPOS)
-    _, visible_order = lax.sort((vis_key, jnp.arange(M, dtype=jnp.int32)),
-                                num_keys=1)
-    doc_index = jnp.full(M, IPOS, jnp.int32).at[order].set(
-        jnp.arange(M, dtype=jnp.int32))
-    doc_index = jnp.where(exists, doc_index, IPOS)
 
-    # ---- 11. Sequential-parity statuses per op.
+    # ---- 12. Document ranks by run contraction + weighted Wyllie.
+    # Maximal ±1-stride index runs of the tour chain occupy contiguous token
+    # intervals (insertion chains make consecutive slots chain their tokens
+    # consecutively), found elementwise; each contracts to one element of a
+    # weighted list ranked by pointer doubling in O(log #runs) trips.
+    # Ranks are computed directly as DENSE indices by weighting tokens with
+    # what they count — existing-node enter tokens for document order,
+    # visible-node enter tokens for the visible order — so no sort is
+    # needed afterwards: rank(v) = (weight at or after enter(root)) -
+    # (weight at or after enter(v)) = weighted count strictly before v.
+    fwd = succ[:-1] == tok[1:]          # token j links to j+1
+    bwd = succ[1:] == tok[:-1]          # token j+1 links to j
+    same_run = fwd | bwd
+    boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
+    rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
+    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(tok)
+    run_e = jnp.zeros(T, jnp.int32).at[rid].max(tok)
+    # direction: +1 when the run's start token links forward (runs never
+    # straddle the enter/exit boundary: token M-1 is the parked NULL slot's
+    # enter and token M the terminal, neither links ±1)
+    run_fwd = succ[run_s] == run_s + 1
+    run_tail = jnp.where(run_fwd, run_e, run_s)
+    tail_succ = succ[run_tail]
+    run_terminal = tail_succ == run_tail
+    run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
+
+    # token weights and their exclusive prefix sums (runs are contiguous,
+    # so within-run partial sums come from one global cumsum)
+    zeros_m = jnp.zeros(M, jnp.int32)
+    w_doc = jnp.concatenate([exists.astype(jnp.int32), zeros_m])
+    w_vis = jnp.concatenate([visible.astype(jnp.int32), zeros_m])
+    cse_doc = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_doc)])
+    cse_vis = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_vis)])
+    # per-run total weight; zero-weight absorbing (terminal) runs make the
+    # Wyllie telescoping exact once pointers collapse
+    def run_sum(cse):
+        return jnp.where(run_terminal, 0, cse[run_e + 1] - cse[run_s])
+
+    wy_cap = _ceil_log2(T) + 1
+
+    def wy_cond(state):
+        _, _, _, live, i = state
+        return live & (i < wy_cap)
+
+    def wy_body(state):
+        a, b, p, _, i = state
+        a2 = a + a[p]
+        b2 = b + b[p]
+        p2 = p[p]
+        return a2, b2, p2, jnp.any(p2 != p), i + 1
+
+    a_doc, a_vis, _, _, _ = lax.while_loop(
+        wy_cond, wy_body,
+        (run_sum(cse_doc), run_sum(cse_vis), run_next, jnp.array(True),
+         jnp.int32(0)))
+
+    # E(tok) = weight at-or-after tok along the chain; within-run offsets
+    # from the global cumsum (forward runs count from the run start,
+    # backward runs toward it)
+    def rank_of(a, cse):
+        within = jnp.where(run_fwd[rid],
+                           cse[tok] - cse[run_s[rid]],
+                           cse[run_e[rid] + 1] - cse[tok + 1])
+        e_tok = a[rid] - within
+        return e_tok[ROOT] - e_tok[:M]
+
+    doc_dense = rank_of(a_doc, cse_doc)
+    vis_dense = rank_of(a_vis, cse_vis)
+
+    doc_index = jnp.where(exists, doc_dense, IPOS)
+    order = jnp.full(M, NULL, jnp.int32).at[
+        jnp.where(exists, doc_dense, M)].set(slot_ids, mode="drop")
+    visible_order = jnp.full(M, NULL, jnp.int32).at[
+        jnp.where(visible, vis_dense, M)].set(slot_ids, mode="drop")
+
+    # ---- 13. Sequential-parity statuses per op.
     status = jnp.full(N, PAD, jnp.int8)
     # adds
     a_slot = op_slot
@@ -358,7 +524,6 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
                             INVALID_PATH)))
     status = jnp.where(is_add, a_status.astype(jnp.int8), status)
     # deletes
-    dp_slot, dp_found = lookup(parent_ts)
     d_parent_ok = (depth == 1) | ((depth >= 2) & dp_found & valid[dp_slot])
     d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
     d_repeat = d_ok & (del_pos[d_tslot] < pos)
@@ -381,3 +546,17 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
         num_nodes=jnp.sum(exists).astype(jnp.int32),
         num_visible=jnp.sum(visible).astype(jnp.int32),
         status=status)
+
+
+def materialize(ops: Dict[str, jax.Array]) -> NodeTable:
+    """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
+
+    Timestamps are int64, so the kernel requires 64-bit mode; if the host
+    program runs JAX in default x32 mode, tracing and input conversion are
+    scoped inside ``jax.enable_x64`` rather than flipping the process-global
+    flag.
+    """
+    if jax.config.jax_enable_x64:
+        return _materialize(ops)
+    with jax.enable_x64(True):
+        return _materialize(ops)

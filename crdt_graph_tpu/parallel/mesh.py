@@ -36,7 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..codec.packed import PackedOps
+from ..codec.packed import KIND_PAD, PackedOps
 from ..ops import merge as merge_mod
 from ..ops.merge import NodeTable
 
@@ -67,7 +67,7 @@ def _pad_ops_to(ops: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
     for k, v in ops.items():
         pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
         if k == "kind":
-            out[k] = np.pad(v, pad_width, constant_values=2)  # KIND_PAD
+            out[k] = np.pad(v, pad_width, constant_values=KIND_PAD)
         elif k == "value_ref":
             out[k] = np.pad(v, pad_width, constant_values=-1)
         elif k == "pos":
@@ -108,8 +108,8 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
         return run()
 
 
-def _batched_kernel(ops: Dict[str, jax.Array]) -> NodeTable:
-    return jax.vmap(merge_mod._materialize.__wrapped__)(ops)
+_batched_kernel = jax.jit(
+    jax.vmap(merge_mod._materialize.__wrapped__))
 
 
 def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
@@ -134,7 +134,7 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
     def run():
         device_ops = {k: jax.device_put(v, NamedSharding(mesh, spec_for(v)))
                       for k, v in ops.items()}
-        return jax.jit(_batched_kernel)(device_ops)
+        return _batched_kernel(device_ops)
 
     if jax.config.jax_enable_x64:
         return run()
