@@ -13,73 +13,34 @@ publishes no numbers (SURVEY §6): vs_baseline > 1 beats the target.
 The workload is the adversarial-but-realistic concurrent shape: every
 replica extends its own insertion chain (each add anchored at the replica's
 previous add, chain heads anchored at the branch sentinel), so the merge
-must interleave 64 chains of ~15.6k ops each under the RGA rule.  Ops are
-synthesized vectorized in numpy; correctness of this shape is pinned by the
-oracle-parity suites in tests/.
+must interleave 64 chains of ~15.6k ops each under the RGA rule.
+Correctness of this shape is pinned by the oracle-parity suites in tests/;
+the full 5-config sweep lives in ``python -m crdt_graph_tpu.bench``.
 """
 import json
 import sys
-import time
-
-import numpy as np
 
 import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from crdt_graph_tpu.ops import merge  # noqa: E402
+from crdt_graph_tpu.bench.runner import time_merge            # noqa: E402
+from crdt_graph_tpu.bench.workloads import chain_workload     # noqa: E402
 
 N_REPLICAS = 64
 N_OPS = 1_000_000
 TARGET_OPS_PER_S = 1e7  # north star: 1M ops < 100 ms
 
 
-def chain_workload(n_replicas: int, n_ops: int, max_depth: int = 16) -> dict:
-    """Packed arrays for n_replicas interleaved flat insertion chains."""
-    per = n_ops // n_replicas
-    n = per * n_replicas
-    rid = np.repeat(np.arange(1, n_replicas + 1, dtype=np.int64), per)
-    counter = np.tile(np.arange(1, per + 1, dtype=np.int64), n_replicas)
-    ts = rid * 2**32 + counter
-    anchor = np.where(counter == 1, 0, ts - 1)
-    paths = np.zeros((n, max_depth), dtype=np.int64)
-    paths[:, 0] = anchor
-    return {
-        "kind": np.zeros(n, dtype=np.int8),           # all adds
-        "ts": ts,
-        "parent_ts": np.zeros(n, dtype=np.int64),
-        "anchor_ts": anchor,
-        "depth": np.ones(n, dtype=np.int32),
-        "paths": paths,
-        "value_ref": np.arange(n, dtype=np.int32),
-        "pos": np.arange(n, dtype=np.int32),
-    }
-
-
 def main() -> None:
     ops = chain_workload(N_REPLICAS, N_OPS)
-    n = int(ops["kind"].shape[0])
-    dev_ops = jax.device_put(ops)
-
-    table = merge.materialize(dev_ops)   # compile + warmup
-    jax.block_until_ready(table.ts)
-    assert int(table.num_visible) == n, "merge dropped ops"
-
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        table = merge.materialize(dev_ops)
-        jax.block_until_ready(table.ts)
-        times.append(time.perf_counter() - t0)
-    p50 = sorted(times)[len(times) // 2]
-    ops_per_s = n / p50
-
-    print(f"device={jax.devices()[0].device_kind} n_ops={n} "
-          f"p50={p50 * 1e3:.1f}ms times_ms="
-          f"{[round(t * 1e3, 1) for t in times]}", file=sys.stderr)
+    stats = time_merge(ops, repeats=5)
+    assert stats["num_visible"] == stats["n_ops"], "merge dropped ops"
+    print(f"device={jax.devices()[0].device_kind} {stats}", file=sys.stderr)
+    ops_per_s = stats["ops_per_sec"]
     print(json.dumps({
         "metric": "crdt_merge_throughput_64rep_1Mops",
-        "value": round(ops_per_s, 1),
+        "value": ops_per_s,
         "unit": "ops/s",
         "vs_baseline": round(ops_per_s / TARGET_OPS_PER_S, 3),
     }))

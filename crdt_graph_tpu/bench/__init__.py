@@ -1,0 +1,5 @@
+"""Benchmark harness: BASELINE.json workload generators and timing runner."""
+from . import workloads
+from .runner import run, time_merge
+
+__all__ = ["workloads", "run", "time_merge"]

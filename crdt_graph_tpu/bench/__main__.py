@@ -1,0 +1,9 @@
+import sys
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .runner import main  # noqa: E402
+
+main(sys.argv[1:])

@@ -1,0 +1,75 @@
+"""Benchmark runner: times the merge kernel on the five BASELINE configs.
+
+Prints one JSON line per config:
+``{"config": n, "name": ..., "n_ops": N, "p50_ms": ..., "ops_per_sec": ...}``
+
+Usage: ``python -m crdt_graph_tpu.bench [config-numbers...]``
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+import jax
+
+from ..codec import packed as packed_mod
+from ..ops import merge
+from . import workloads
+
+
+def _as_arrays(workload) -> Dict[str, np.ndarray]:
+    if isinstance(workload, dict):
+        return workload
+    return packed_mod.pack(workload).arrays()
+
+
+def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5) -> dict:
+    """Compile, warm up, and time the jitted merge; returns timing stats."""
+    dev_ops = jax.device_put(ops)
+    t0 = time.perf_counter()
+    table = merge.materialize(dev_ops)
+    jax.block_until_ready(table.ts)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        table = merge.materialize(dev_ops)
+        jax.block_until_ready(table.ts)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
+    return {
+        "n_ops": n,
+        "p50_ms": round(p50 * 1e3, 2),
+        "ops_per_sec": round(n / p50, 1),
+        "compile_ms": round(compile_s * 1e3, 1),
+        "num_nodes": int(table.num_nodes),
+        "num_visible": int(table.num_visible),
+    }
+
+
+def run(config_ids: Optional[Iterable[int]] = None,
+        repeats: int = 5) -> list:
+    results = []
+    for cid in (config_ids or sorted(workloads.CONFIGS)):
+        name, gen = workloads.CONFIGS[cid]
+        ops = _as_arrays(gen())
+        stats = time_merge(ops, repeats=repeats)
+        row = {"config": cid, "name": name, **stats}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    return results
+
+
+def main(argv) -> None:
+    ids = [int(a) for a in argv] or None
+    print(f"# device: {jax.devices()[0].device_kind}", file=sys.stderr)
+    run(ids)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

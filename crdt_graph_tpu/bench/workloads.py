@@ -1,0 +1,174 @@
+"""Workload generators for the five BASELINE.json benchmark configs.
+
+Each generator emits a causally valid operation stream shaped like the
+config's scenario (anchors always reference earlier-generated nodes, the
+way honest replicas behave), either as a Python op list (small sizes, for
+oracle cross-checks) or as packed numpy arrays directly (large sizes, so
+generation never bottlenecks on Python object churn).
+
+Configs (BASELINE.json `configs`):
+1. flat RGA text buffer, 1 replica, 1k add/delete ops (editor replay)
+2. 2-replica concurrent flat-list merge, 10k interleaved ops
+3. nested tree depth 8, 8-replica merge, add-dominated
+4. wide-fanout tree, tombstone-heavy (90% delete), 32 replicas
+5. 64-replica × 1M-op batched semilattice join
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.operation import Add, Delete, Operation
+
+OFFSET = 2**32
+
+
+def _ts(rid: int, counter: int) -> int:
+    return rid * OFFSET + counter
+
+
+def editor_replay(n_ops: int = 1000, seed: int = 0,
+                  append_p: float = 0.75) -> List[Operation]:
+    """Config 1: one replica typing into a flat buffer — mostly appends at
+    the caret, occasional backspaces (delete of the previous char)."""
+    rng = random.Random(seed)
+    ops: List[Operation] = []
+    counter = 0
+    alive: List[int] = []          # timestamps of visible chars, in order
+    caret = 0                      # index into alive AFTER which we type
+    for _ in range(n_ops):
+        if alive and rng.random() >= append_p:
+            # backspace at the caret
+            k = caret - 1 if caret > 0 else 0
+            ops.append(Delete((alive.pop(k),)))
+            caret = max(0, caret - 1)
+        else:
+            counter += 1
+            ts = _ts(1, counter)
+            anchor = alive[caret - 1] if caret > 0 else 0
+            ops.append(Add(ts, (anchor,), chr(97 + counter % 26)))
+            alive.insert(caret, ts)
+            caret += 1
+        # occasionally jump the caret (editing elsewhere)
+        if rng.random() < 0.05:
+            caret = rng.randrange(len(alive) + 1)
+    return ops
+
+
+def two_replica_interleaved(n_ops: int = 10_000,
+                            rounds: int = 50) -> List[Operation]:
+    """Config 2: two replicas typing concurrently in bursts, syncing between
+    rounds — each round both extend the document at the same point, so the
+    merge must interleave burst chains under the RGA rule."""
+    per_round = max(1, n_ops // (2 * rounds))
+    ops: List[Operation] = []
+    counters = [0, 0]
+    shared_anchor = 0              # last synced char both replicas see
+    for _ in range(rounds):
+        round_tails = []
+        for r in (0, 1):
+            anchor = shared_anchor
+            for _ in range(per_round):
+                counters[r] += 1
+                ts = _ts(r + 1, counters[r])
+                ops.append(Add(ts, (anchor,), r))
+                anchor = ts
+            round_tails.append(anchor)
+        # next round both type after replica 1's tail (post-sync caret)
+        shared_anchor = round_tails[0]
+    return ops
+
+
+def nested_tree(n_ops: int = 100_000, n_replicas: int = 8,
+                depth: int = 8, seed: int = 3) -> List[Operation]:
+    """Config 3: depth-``depth`` nested tree, add-dominated.  Replica 1
+    builds a nesting skeleton; then all replicas append character chains
+    under branches at every level (anchoring at branch sentinels and their
+    own previous chars — causally valid without cross-replica anchors)."""
+    rng = random.Random(seed)
+    ops: List[Operation] = []
+    counters = {r: 0 for r in range(1, n_replicas + 1)}
+
+    def stamp(r):
+        counters[r] += 1
+        return _ts(r, counters[r])
+
+    # skeleton: a chain of nested branches from replica 1
+    branch_paths = [()]            # parent paths of available branches
+    path: tuple = ()
+    for _ in range(depth - 1):
+        ts = stamp(1)
+        ops.append(Add(ts, path + (0,), "b"))
+        path = path + (ts,)
+        branch_paths.append(path)
+    # bursts: each replica picks a branch and appends a chain under it
+    remaining = n_ops - len(ops)
+    burst = 64
+    while remaining > 0:
+        r = rng.randrange(1, n_replicas + 1)
+        parent = rng.choice(branch_paths)
+        anchor_path = parent + (0,)
+        for _ in range(min(burst, remaining)):
+            ts = stamp(r)
+            ops.append(Add(ts, anchor_path, "x"))
+            anchor_path = parent + (ts,)
+        remaining -= burst
+    return ops
+
+
+def tombstone_heavy(n_adds: int = 40_000, n_replicas: int = 32,
+                    delete_frac: float = 0.9,
+                    seed: int = 4) -> List[Operation]:
+    """Config 4: wide fanout — every replica appends children directly at
+    the root sentinel (maximal sibling concurrency), then deletes 90% of
+    its own — the tombstone-chain stress the reference's traversal
+    degrades on (SURVEY §3.5)."""
+    rng = random.Random(seed)
+    ops: List[Operation] = []
+    per = n_adds // n_replicas
+    for r in range(1, n_replicas + 1):
+        for c in range(1, per + 1):
+            ops.append(Add(_ts(r, c), (0,), c))
+    for r in range(1, n_replicas + 1):
+        doomed = rng.sample(range(1, per + 1), int(per * delete_frac))
+        ops.extend(Delete((_ts(r, c),)) for c in doomed)
+    return ops
+
+
+def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
+                   max_depth: int = 16) -> Dict[str, np.ndarray]:
+    """Config 5 (and the bench.py headline): packed arrays for
+    ``n_replicas`` interleaved flat insertion chains — every replica
+    extends its own chain from the shared branch head, so the merge
+    interleaves ``n_replicas`` chains of ``n_ops/n_replicas`` ops each
+    under the RGA rule.  Generated vectorized (no Python op objects)."""
+    per = n_ops // n_replicas
+    n = per * n_replicas
+    rid = np.repeat(np.arange(1, n_replicas + 1, dtype=np.int64), per)
+    counter = np.tile(np.arange(1, per + 1, dtype=np.int64), n_replicas)
+    ts = rid * OFFSET + counter
+    anchor = np.where(counter == 1, 0, ts - 1)
+    paths = np.zeros((n, max_depth), dtype=np.int64)
+    paths[:, 0] = anchor
+    return {
+        "kind": np.zeros(n, dtype=np.int8),           # all adds
+        "ts": ts,
+        "parent_ts": np.zeros(n, dtype=np.int64),
+        "anchor_ts": anchor,
+        "depth": np.ones(n, dtype=np.int32),
+        "paths": paths,
+        "value_ref": np.arange(n, dtype=np.int32),
+        "pos": np.arange(n, dtype=np.int32),
+    }
+
+
+CONFIGS = {
+    1: ("flat_editor_replay_1k", lambda: editor_replay(1000)),
+    2: ("two_replica_interleaved_10k",
+        lambda: two_replica_interleaved(10_000)),
+    3: ("nested_depth8_8rep_100k", lambda: nested_tree(100_000)),
+    4: ("tombstone_heavy_32rep", lambda: tombstone_heavy(40_000)),
+    5: ("join_64rep_1M", lambda: chain_workload(64, 1_000_000)),
+}

@@ -259,6 +259,22 @@ def find(pred: Callable[[Node], bool], parent: Node) -> Optional[Node]:
     return None
 
 
+def loop(func: Callable[[Node, Any], Any], acc: Any, parent: Node) -> Any:
+    """Fold over visible children from the left while the step is "take"
+    (CRDTree/Node.elm:136-160).  ``func(node, acc)`` returns ``(step, acc)``
+    with step ``"take"`` to continue or ``"done"`` to stop early."""
+    for node in iter_visible(parent):
+        step, acc = func(node, acc)
+        if step == "done":
+            return acc
+    return acc
+
+
+def children(parent: Node) -> List[Node]:
+    """Visible children in list order (CRDTree/Node.elm:94-98)."""
+    return list(iter_visible(parent))
+
+
 def head(parent: Node) -> Optional[Node]:
     for n in iter_visible(parent):
         return n

@@ -1,0 +1,4 @@
+"""Application-layer document models built on the replica engines."""
+from .text import TextBuffer
+
+__all__ = ["TextBuffer"]

@@ -1,0 +1,123 @@
+"""Collaborative text buffer: the reference's companion-app workload.
+
+The reference package exists to power a collaborative text editor
+(README.md:3); this model is that application layer rebuilt on either
+engine: a flat RGA of single-character nodes in the root branch, edited by
+index, synced by operation batches.  It is also the workload generator for
+BASELINE.json config 1 (flat text buffer replay).
+
+Index-addressed editing maps onto path-addressed CRDT ops:
+
+- ``insert(i, "abc")`` anchors 'a' after the (i-1)-th visible character
+  (or the branch-head sentinel for i=0) and chains 'b' after 'a', 'c' after
+  'b' — one atomic batch, one timestamp per character.
+- ``delete(i, n)`` tombstones the paths of the n visible characters from i.
+- Concurrent remote edits merge through ``apply``; RGA placement decides
+  interleavings (higher timestamp sits closer to the shared anchor).
+
+Backed by ``engine="tpu"`` (array engine, batched merges) or ``"oracle"``
+(persistent pure-Python state machine) — identical semantics, pinned by
+tests/test_text_model.py.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from .. import engine as tpu_engine
+from ..core import tree as oracle_mod
+from ..core.operation import Batch, Operation
+
+
+class TextBuffer:
+    """A replicated text document; see module docstring."""
+
+    def __init__(self, replica: int, engine: str = "tpu"):
+        if engine == "tpu":
+            self._t = tpu_engine.init(replica)
+        elif engine == "oracle":
+            self._t = oracle_mod.init(replica)
+        else:
+            raise ValueError(f"unknown engine {engine!r}")
+        self._engine = engine
+
+    # -- views ------------------------------------------------------------
+
+    def text(self) -> str:
+        return "".join(str(v) for v in self._visible_values())
+
+    def __len__(self) -> int:
+        return len(self._visible_values())
+
+    def _visible_values(self) -> List[str]:
+        return self._t.visible_values()
+
+    def _visible_paths(self) -> List[Tuple[int, ...]]:
+        if self._engine == "tpu":
+            return self._t.visible_paths()
+        paths: List[Tuple[int, ...]] = []
+        self._t.walk(lambda n, acc: ("take", acc.append(n.path) or acc),
+                     paths)
+        return paths
+
+    # -- local edits ------------------------------------------------------
+
+    def insert(self, index: int, chunk: str) -> Operation:
+        """Insert ``chunk`` before the character at ``index`` (index == len
+        appends); returns the delta to broadcast."""
+        if not 0 <= index <= len(self):
+            raise IndexError(f"insert index {index} out of range")
+        if not chunk:
+            return Batch(())
+        anchor = self._anchor_path(index)
+
+        def first(t):
+            return t.add_after(anchor, chunk[0])
+
+        funcs = [first]
+        for ch in chunk[1:]:
+            funcs.append(lambda t, c=ch: t.add(c))
+        self._t = self._t.batch(funcs)
+        return self._t.last_operation
+
+    def delete(self, index: int, count: int = 1) -> Operation:
+        """Delete ``count`` characters starting at ``index``; returns the
+        delta to broadcast."""
+        if count < 0 or index < 0 or index + count > len(self):
+            raise IndexError(f"delete [{index}, {index + count}) out of "
+                             f"range for length {len(self)}")
+        doomed = self._visible_paths()[index:index + count]
+        self._t = self._t.batch(
+            [lambda t, p=p: t.delete(p) for p in doomed])
+        return self._t.last_operation
+
+    def _anchor_path(self, index: int) -> Sequence[int]:
+        if index == 0:
+            return (0,)
+        return self._visible_paths()[index - 1]
+
+    # -- replication ------------------------------------------------------
+
+    @property
+    def replica_id(self) -> int:
+        return self._t.replica_id
+
+    @property
+    def last_operation(self) -> Operation:
+        return self._t.last_operation
+
+    def apply(self, delta: Operation) -> "TextBuffer":
+        """Merge a remote delta (cursor-stable, idempotent)."""
+        self._t = self._t.apply(delta)
+        return self
+
+    def operations_since(self, ts: int) -> Operation:
+        return self._t.operations_since(ts)
+
+    def last_replica_timestamp(self, replica: int) -> int:
+        return self._t.last_replica_timestamp(replica)
+
+    def sync_from(self, peer: "TextBuffer") -> "TextBuffer":
+        """Pull-based anti-entropy: fetch everything newer than the last
+        timestamp seen from the peer (CRDTree.elm:390-418 pattern)."""
+        since = self.last_replica_timestamp(peer.replica_id)
+        return self.apply(peer.operations_since(since))

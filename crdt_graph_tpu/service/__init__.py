@@ -1,0 +1,5 @@
+"""Wire service: the server side of the reference's deployment model."""
+from .http import make_server, serve
+from .store import Document, DocumentStore
+
+__all__ = ["Document", "DocumentStore", "make_server", "serve"]

@@ -1,0 +1,125 @@
+"""HTTP wire service speaking the reference JSON codec.
+
+Endpoints (all JSON; the operation payloads are byte-compatible with the
+reference codec, CRDTree/Operation.elm:109-159, so Elm clients — e.g. the
+companion text editor — interoperate unmodified):
+
+- ``POST /docs/{id}/replicas``         → ``{"replica": n}``  (coordinator
+  role: unique numeric replica ids, README.md:20-22)
+- ``POST /docs/{id}/ops``   body = op  → ``{"accepted": bool, "applied": op}``
+  (merge a delta; rejection = causality gap, client syncs and retries)
+- ``GET  /docs/{id}/ops?since=ts``     → op batch (pull anti-entropy,
+  CRDTree.elm:390-418)
+- ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
+- ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
+
+Run: ``python -m crdt_graph_tpu.service [port]`` or embed via
+``serve(port)`` / ``make_server(port)``.
+"""
+from __future__ import annotations
+
+import json
+import re
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..codec.json_codec import DecodeError
+from .store import DocumentStore
+
+_DOC = re.compile(r"^/docs/([A-Za-z0-9_.-]+)(/.*)?$")
+
+
+def make_handler(store: DocumentStore):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):   # quiet by default
+            pass
+
+        def _send(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _route(self) -> Tuple[Optional[str], str, dict]:
+            url = urlparse(self.path)
+            m = _DOC.match(url.path)
+            if not m:
+                return None, url.path, parse_qs(url.query)
+            return m.group(1), (m.group(2) or ""), parse_qs(url.query)
+
+        def _body(self) -> str:
+            n = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(n).decode()
+
+        def do_GET(self):
+            doc_id, sub, query = self._route()
+            if doc_id is None:
+                if sub == "/metrics":
+                    self._send(200, {d: store.get(d).metrics()
+                                     for d in store.ids()})
+                elif sub == "/docs":
+                    self._send(200, {"docs": store.ids()})
+                else:
+                    self._send(404, {"error": "not found"})
+                return
+            doc = store.get(doc_id, create=False)
+            if doc is None:
+                self._send(404, {"error": f"no document {doc_id}"})
+                return
+            if sub == "":
+                self._send(200, {"values": doc.snapshot()})
+            elif sub == "/ops":
+                try:
+                    since = int(query.get("since", ["0"])[0])
+                except ValueError:
+                    self._send(400, {"error": "since must be an integer"})
+                    return
+                self._send(200, json.loads(
+                    store.encode_ops(doc.operations_since(since))))
+            elif sub == "/metrics":
+                self._send(200, doc.metrics())
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            # validate route and body BEFORE store.get(create=True), so
+            # invalid requests never materialize documents
+            doc_id, sub, _ = self._route()
+            if doc_id is None or sub not in ("/replicas", "/ops"):
+                self._send(404, {"error": "not found"})
+                return
+            if sub == "/replicas":
+                self._send(200,
+                           {"replica": store.get(doc_id).assign_replica()})
+                return
+            try:
+                op = store.decode_ops(self._body())
+            except (DecodeError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            accepted, applied = store.get(doc_id).apply(op)
+            self._send(200 if accepted else 409, {
+                "accepted": accepted,
+                "applied": json.loads(store.encode_ops(applied)),
+            })
+
+    return Handler
+
+
+def make_server(port: int = 0,
+                store: Optional[DocumentStore] = None) -> ThreadingHTTPServer:
+    store = store or DocumentStore()
+    server = ThreadingHTTPServer(("127.0.0.1", port), make_handler(store))
+    server.store = store
+    return server
+
+
+def serve(port: int = 8900) -> None:
+    server = make_server(port)
+    print(f"crdt_graph_tpu service on 127.0.0.1:{server.server_port}")
+    server.serve_forever()

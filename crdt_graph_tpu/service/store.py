@@ -1,0 +1,114 @@
+"""Server-side document store: replicated trees keyed by document id.
+
+The reference's deployment model (README.md:5-9, 20-22) needs two server
+roles it leaves to the application: a coordinator that hands out unique
+numeric replica ids, and a relay/merger that moves operation batches
+between replicas.  ``DocumentStore`` is both, backed by the TPU engine:
+each document is one server replica that merges every client's deltas
+(one batched kernel call per apply) and serves pull-based anti-entropy
+(``operations_since``) to any client.
+
+Observability counters (SURVEY §5 metrics row: ops merged, dedup hits,
+rejected batches) are served alongside.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import engine as engine_mod
+from ..codec import json_codec
+from ..core import operation as op_mod
+from ..core.errors import CRDTError
+from ..core.operation import Operation
+
+SERVER_REPLICA = 0   # the server's own replica id; clients get 1, 2, …
+
+
+class Document:
+    """One replicated document plus its merge counters."""
+
+    def __init__(self, doc_id: str, max_depth: int = 16):
+        self.doc_id = doc_id
+        self.tree = engine_mod.init(SERVER_REPLICA, max_depth=max_depth)
+        self.next_replica = 1
+        self.ops_merged = 0
+        self.dup_absorbed = 0
+        self.batches_rejected = 0
+        self.lock = threading.Lock()
+
+    def assign_replica(self) -> int:
+        with self.lock:
+            rid = self.next_replica
+            self.next_replica += 1
+            return rid
+
+    def apply(self, operation: Operation) -> Tuple[bool, Operation]:
+        """Merge a client delta.  Returns (accepted, applied_ops).
+
+        A rejected batch (causality gap / invalid path) leaves the document
+        untouched — the client should sync and retry, the reference's
+        recovery contract (CRDTree.elm:104-107)."""
+        leaves = list(op_mod.iter_leaves(operation))
+        with self.lock:
+            try:
+                self.tree.apply(operation)
+            except CRDTError:
+                self.batches_rejected += 1
+                return False, op_mod.from_list([])
+            applied = self.tree.last_operation
+            n_applied = len(op_mod.to_list(applied))
+            self.ops_merged += n_applied
+            self.dup_absorbed += len(leaves) - n_applied
+            return True, applied
+
+    def operations_since(self, ts: int) -> Operation:
+        with self.lock:
+            return self.tree.operations_since(ts)
+
+    def snapshot(self) -> List[Any]:
+        with self.lock:
+            return self.tree.visible_values()
+
+    def metrics(self) -> Dict[str, int]:
+        with self.lock:
+            return {
+                "ops_merged": self.ops_merged,
+                "dup_absorbed": self.dup_absorbed,
+                "batches_rejected": self.batches_rejected,
+                "num_visible": len(self.tree),
+                "log_length": len(op_mod.to_list(
+                    self.tree.operations_since(0))),
+                "replicas_assigned": self.next_replica - 1,
+            }
+
+
+class DocumentStore:
+    """All documents hosted by this server."""
+
+    def __init__(self, max_depth: int = 16):
+        self._docs: Dict[str, Document] = {}
+        self._lock = threading.Lock()
+        self._max_depth = max_depth
+
+    def get(self, doc_id: str, create: bool = True) -> Optional[Document]:
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None and create:
+                doc = self._docs[doc_id] = Document(
+                    doc_id, max_depth=self._max_depth)
+            return doc
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._docs)
+
+    # -- wire-format helpers ---------------------------------------------
+
+    @staticmethod
+    def encode_ops(op: Operation) -> str:
+        return json_codec.dumps(op)
+
+    @staticmethod
+    def decode_ops(payload: str) -> Operation:
+        return json_codec.loads(payload)

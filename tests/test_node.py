@@ -186,3 +186,24 @@ def test_delete_after_tombstone_interleave(flat_example):
     root = N.add_after(flat_example, [2], 35, "y")  # lands before tombstone 3
     root2 = N.delete(root, [4])  # delete "c"
     assert values(root2) == ["a", "b", "y", "d"]
+
+
+def test_loop_early_exit_and_children():
+    """`loop` folds visible children until "done"; `children` lists them
+    (CRDTree/Node.elm:94-98, 136-160)."""
+    from crdt_graph_tpu.core import node as node_mod
+    root = node_mod.Node.root()
+    root = node_mod.add_after(root, (0,), 1, "a")
+    root = node_mod.add_after(root, (1,), 2, "b")
+    root = node_mod.add_after(root, (2,), 3, "c")
+    root = node_mod.delete(root, (2,))
+
+    kids = node_mod.children(root)
+    assert [n.value for n in kids] == ["a", "c"]
+
+    seen = node_mod.loop(
+        lambda n, acc: ("take", acc + [n.value]), [], root)
+    assert seen == ["a", "c"]
+    first = node_mod.loop(
+        lambda n, acc: ("done", n.value), None, root)
+    assert first == "a"

@@ -1,0 +1,111 @@
+"""Wire-service end-to-end: two clients collaborating on one document over
+real HTTP, speaking the reference-compatible JSON codec."""
+import json
+import threading
+from http.client import HTTPConnection
+
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.models import TextBuffer
+from crdt_graph_tpu.service import make_server
+
+
+@pytest.fixture()
+def server():
+    srv = make_server(port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def req(srv, method, path, body=None):
+    conn = HTTPConnection("127.0.0.1", srv.server_port, timeout=30)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    payload = json.loads(resp.read().decode())
+    conn.close()
+    return resp.status, payload
+
+
+def test_collaboration_roundtrip(server):
+    # two clients join and get distinct replica ids
+    _, r1 = req(server, "POST", "/docs/novel/replicas")
+    _, r2 = req(server, "POST", "/docs/novel/replicas")
+    assert r1["replica"] != r2["replica"]
+
+    a = TextBuffer(r1["replica"])
+    b = TextBuffer(r2["replica"])
+    a.insert(0, "hello")
+    delta = json_codec.dumps(a.operations_since(0))
+    st, out = req(server, "POST", "/docs/novel/ops", delta)
+    assert st == 200 and out["accepted"]
+
+    # b pulls everything, edits, pushes
+    _, ops = req(server, "GET", "/docs/novel/ops?since=0")
+    b.apply(json_codec.decode(ops))
+    assert b.text() == "hello"
+    b.insert(5, " world")
+    since = b.last_replica_timestamp(b.replica_id)
+    st, _ = req(server, "POST", "/docs/novel/ops",
+                json_codec.dumps(b.last_operation))
+    assert st == 200
+
+    # server snapshot reflects the merge; a converges by pulling
+    _, snap = req(server, "GET", "/docs/novel")
+    assert "".join(snap["values"]) == "hello world"
+    _, ops = req(server, "GET", "/docs/novel/ops?since=0")
+    a.apply(json_codec.decode(ops))
+    assert a.text() == "hello world"
+
+
+def test_duplicate_push_absorbed(server):
+    a = TextBuffer(1)
+    a.insert(0, "x")
+    delta = json_codec.dumps(a.operations_since(0))
+    req(server, "POST", "/docs/d/ops", delta)
+    req(server, "POST", "/docs/d/ops", delta)
+    _, metrics = req(server, "GET", "/docs/d/metrics")
+    assert metrics["ops_merged"] == 1
+    assert metrics["dup_absorbed"] == 1
+    assert metrics["num_visible"] == 1
+
+
+def test_causality_gap_rejected_and_recoverable(server):
+    # op anchored at a node the server has never seen → 409, doc untouched
+    orphan = json_codec.dumps(crdt.Add(5 * 2**32 + 1, (999,), "z"))
+    st, out = req(server, "POST", "/docs/g/ops", orphan)
+    assert st == 409 and not out["accepted"]
+    _, metrics = req(server, "GET", "/docs/g/metrics")
+    assert metrics["batches_rejected"] == 1
+    assert metrics["num_visible"] == 0
+    # after syncing the missing context, the same edit applies
+    base = json_codec.dumps(crdt.Add(999, (0,), "base"))
+    st, _ = req(server, "POST", "/docs/g/ops", base)
+    assert st == 200
+    st, _ = req(server, "POST", "/docs/g/ops", orphan)
+    assert st == 200
+
+
+def test_malformed_payload_400(server):
+    st, _ = req(server, "POST", "/docs/m/ops", '{"op": "add"}')
+    assert st == 400
+    st, _ = req(server, "POST", "/docs/m/ops", "not json at all")
+    assert st == 400
+
+
+def test_unknown_doc_404(server):
+    st, _ = req(server, "GET", "/docs/nope")
+    assert st == 404
+    st, _ = req(server, "GET", "/bogus")
+    assert st == 404
+
+
+def test_global_metrics_lists_docs(server):
+    req(server, "POST", "/docs/one/replicas")
+    req(server, "POST", "/docs/two/replicas")
+    _, m = req(server, "GET", "/metrics")
+    assert set(m) == {"one", "two"}
