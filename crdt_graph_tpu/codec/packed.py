@@ -193,3 +193,18 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     shifted[shifted >= 0] += len(a.values)
     out.value_ref[na:n] = shifted
     return out
+
+
+def pack_json(payload, max_depth: int = DEFAULT_MAX_DEPTH,
+              capacity: Optional[int] = None) -> PackedOps:
+    """Wire JSON (str/bytes) → :class:`PackedOps`, using the native parser
+    when available (crdt_graph_tpu.native), else the pure-Python path."""
+    from .. import native
+    if native.available():
+        return native.parse_pack(payload, max_depth=max_depth,
+                                 capacity=capacity)
+    from . import json_codec
+    if isinstance(payload, bytes):
+        payload = payload.decode()
+    return pack(json_codec.loads(payload), max_depth=max_depth,
+                capacity=capacity)

@@ -69,6 +69,11 @@ class TpuTree:
     def last_operation(self) -> Operation:
         return self._last_operation
 
+    @property
+    def log_length(self) -> int:
+        """Applied-op count, O(1) (the op log IS the state)."""
+        return len(self._log)
+
     def next_timestamp(self) -> int:
         return self._timestamp + 1
 

@@ -1,0 +1,119 @@
+"""Native runtime components.
+
+``_fastcodec`` (fastcodec.cpp) parses reference-format JSON operation
+batches straight into packed numpy columns, bypassing per-op Python object
+construction — the host-side ingest path for large merges.  Built on first
+use with the system compiler (g++, CPython C API only — no third-party
+build deps); everything falls back to the pure-Python codec when a compiler
+is unavailable, so the native layer is an accelerator, never a requirement.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+import numpy as np
+
+from ..codec.packed import DEFAULT_MAX_DEPTH, PackedOps, _bucket
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "fastcodec.cpp")
+_SO = os.path.join(_HERE, "_fastcodec.so")
+
+_mod = None
+_build_error: Optional[str] = None
+
+
+def _try_import():
+    """Load the extension by file path — no sys.path mutation."""
+    global _mod
+    spec = importlib.util.spec_from_file_location(
+        "crdt_graph_tpu.native._fastcodec", _SO)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _mod = mod
+    return _mod
+
+
+def _build() -> None:
+    include = sysconfig.get_path("include")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load(rebuild: bool = False):
+    """The native module, building it if needed; None if unavailable."""
+    global _mod, _build_error
+    if _mod is not None and not rebuild:
+        return _mod
+    if _build_error is not None and not rebuild:
+        return None
+    try:
+        if rebuild or not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            _build()
+        return _try_import()
+    except Exception as e:   # missing compiler, sandboxed fs, …
+        _build_error = str(e)
+        return None
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
+               capacity: Optional[int] = None) -> PackedOps:
+    """Wire JSON (str/bytes) → :class:`PackedOps` via the native parser.
+
+    Raises ``RuntimeError`` when the native module is unavailable — callers
+    wanting transparent fallback should use
+    :func:`crdt_graph_tpu.codec.packed.pack_json`.
+    """
+    mod = load()
+    if mod is None:
+        raise RuntimeError(f"native codec unavailable: {_build_error}")
+    if isinstance(payload, str):
+        payload = payload.encode()
+    cols = mod.parse_pack(payload, max_depth)
+    n = cols["n"]
+    cap = capacity if capacity is not None else _bucket(n)
+    if cap < n:
+        raise ValueError(f"capacity {cap} < op count {n}")
+
+    def col(name, dtype, shape=None):
+        arr = np.frombuffer(cols[name], dtype=dtype)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return arr
+
+    kind = np.full(cap, 2, dtype=np.int8)           # KIND_PAD
+    kind[:n] = col("kind", np.int8)
+    out = PackedOps(
+        kind=kind,
+        ts=_padded(col("ts", np.int64), cap),
+        parent_ts=_padded(col("parent_ts", np.int64), cap),
+        anchor_ts=_padded(col("anchor_ts", np.int64), cap),
+        depth=_padded(col("depth", np.int32), cap),
+        paths=_padded2(col("paths", np.int64, (n, max_depth)), cap),
+        value_ref=_padded(col("value_ref", np.int32), cap, fill=-1),
+        pos=np.arange(cap, dtype=np.int32),
+        values=cols["values"],
+        num_ops=n)
+    return out
+
+
+def _padded(a: np.ndarray, cap: int, fill=0) -> np.ndarray:
+    out = np.full(cap, fill, dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
+
+
+def _padded2(a: np.ndarray, cap: int) -> np.ndarray:
+    out = np.zeros((cap, a.shape[1]), dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out

@@ -1,0 +1,593 @@
+// Native wire codec: reference-format JSON -> packed operation columns.
+//
+// The TPU merge kernel consumes struct-of-array operation batches
+// (codec/packed.py).  The pure-Python path (json.loads -> Operation objects
+// -> pack()) builds millions of Python objects per large batch and caps
+// ingest around half a million ops/s — far below the device's merge rate.
+// This extension parses the wire format (CRDTree/Operation.elm:109-159 —
+// {"op":"add","path":[..],"ts":n,"val":..}, {"op":"del","path":[..]},
+// {"op":"batch","ops":[..]}, unknown tags = empty batch) straight into
+// int64/int32/int8 columns in a single pass, building Python objects only
+// for the opaque "val" payloads.
+//
+// Exposed as crdt_graph_tpu.native._fastcodec.parse_pack(payload, max_depth)
+// -> dict of bytes columns + values list + count; the Python wrapper wraps
+// them in numpy without copying (np.frombuffer) and pads to capacity.
+// Semantics (flatten order, strict ints, range checks) mirror
+// codec/json_codec.py + codec/packed.py and are pinned by
+// tests/test_native_codec.py.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t MAX_TS = int64_t(1) << 62;  // kernel sentinel space
+
+struct Columns {
+  std::vector<int8_t> kind;
+  std::vector<int64_t> ts, parent, anchor;
+  std::vector<int32_t> depth, value_ref;
+  std::vector<int64_t> paths;  // row-major [n, max_depth]
+  PyObject* values;            // list of parsed "val" payloads
+  int max_depth;
+};
+
+struct Parser {
+  const char* begin;
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit Parser(const char* data, Py_ssize_t n)
+      : begin(data), p(data), end(data + n) {}
+
+  bool fail(const std::string& m) {
+    if (err.empty()) {
+      err = m + " at offset " + std::to_string(size_t(p - begin));
+    }
+    return false;
+  }
+
+  void ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      ++p;
+    }
+  }
+
+  bool lit(const char* s) {
+    size_t n = std::strlen(s);
+    if (size_t(end - p) < n || std::memcmp(p, s, n) != 0) {
+      return fail(std::string("expected '") + s + "'");
+    }
+    p += n;
+    return true;
+  }
+
+  // ---- numbers ----
+  // Integral JSON number -> int64 (strict: JSON int grammar -?(0|[1-9]\d*),
+  // no fraction/exponent, in-range).
+  bool int64_field(int64_t* out) {
+    ws();
+    bool neg = false;
+    if (p < end && *p == '-') { neg = true; ++p; }
+    if (p >= end || *p < '0' || *p > '9') return fail("expected integer");
+    if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9') {
+      return fail("leading zero in integer");
+    }
+    uint64_t v = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      uint64_t d = uint64_t(*p - '0');
+      if (v > (UINT64_MAX - d) / 10) return fail("integer overflow");
+      v = v * 10 + d;
+      ++p;
+    }
+    if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) {
+      return fail("expected integer, got float");
+    }
+    if (v > uint64_t(INT64_MAX)) return fail("integer overflow");
+    *out = neg ? -int64_t(v) : int64_t(v);
+    return true;
+  }
+
+  // Full JSON number grammar: int frac? exp?  (used for value payloads).
+  bool scan_number(bool* is_float) {
+    *is_float = false;
+    if (p < end && *p == '-') ++p;
+    if (p >= end || *p < '0' || *p > '9') return fail("bad number");
+    if (*p == '0') {
+      ++p;
+    } else {
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && *p == '.') {
+      *is_float = true;
+      ++p;
+      if (p >= end || *p < '0' || *p > '9') return fail("bad number");
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      *is_float = true;
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      if (p >= end || *p < '0' || *p > '9') return fail("bad number");
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    return true;
+  }
+
+  // ---- strings ----
+  bool hex4(unsigned* out) {
+    if (end - p < 4) return fail("bad \\u escape");
+    unsigned v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = p[i];
+      unsigned d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else return fail("bad \\u escape");
+      v = (v << 4) | d;
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  static void append_utf8(std::string& s, unsigned cp) {
+    if (cp < 0x80) {
+      s += char(cp);
+    } else if (cp < 0x800) {
+      s += char(0xC0 | (cp >> 6));
+      s += char(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      s += char(0xE0 | (cp >> 12));
+      s += char(0x80 | ((cp >> 6) & 0x3F));
+      s += char(0x80 | (cp & 0x3F));
+    } else {
+      s += char(0xF0 | (cp >> 18));
+      s += char(0x80 | ((cp >> 12) & 0x3F));
+      s += char(0x80 | ((cp >> 6) & 0x3F));
+      s += char(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool string_raw(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    out->clear();
+    while (p < end) {
+      unsigned char c = *p;
+      if (c == '"') { ++p; return true; }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return fail("unterminated escape");
+        char e = *p++;
+        switch (e) {
+          case '"': *out += '"'; break;
+          case '\\': *out += '\\'; break;
+          case '/': *out += '/'; break;
+          case 'b': *out += '\b'; break;
+          case 'f': *out += '\f'; break;
+          case 'n': *out += '\n'; break;
+          case 'r': *out += '\r'; break;
+          case 't': *out += '\t'; break;
+          case 'u': {
+            unsigned cp;
+            if (!hex4(&cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              if (end - p >= 2 && p[0] == '\\' && p[1] == 'u') {
+                p += 2;
+                unsigned lo;
+                if (!hex4(&lo)) return false;
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else {
+                  return fail("bad surrogate pair");
+                }
+              } else {
+                return fail("lone surrogate");
+              }
+            }
+            append_utf8(*out, cp);
+            break;
+          }
+          default:
+            return fail("bad escape");
+        }
+      } else if (c < 0x20) {
+        return fail("control char in string");
+      } else {
+        *out += char(c);
+        ++p;
+      }
+    }
+    return fail("unterminated string");
+  }
+
+  // ---- generic values (for "val" payloads) -> Python objects ----
+  PyObject* value_py() {
+    ws();
+    if (p >= end) { fail("unexpected end"); return nullptr; }
+    switch (*p) {
+      case '{': {
+        ++p;
+        PyObject* d = PyDict_New();
+        if (!d) return nullptr;
+        ws();
+        if (p < end && *p == '}') { ++p; return d; }
+        while (true) {
+          std::string key;
+          if (!string_raw(&key)) { Py_DECREF(d); return nullptr; }
+          ws();
+          if (p >= end || *p != ':') {
+            fail("expected ':'");
+            Py_DECREF(d);
+            return nullptr;
+          }
+          ++p;
+          PyObject* v = value_py();
+          if (!v) { Py_DECREF(d); return nullptr; }
+          PyObject* k = PyUnicode_DecodeUTF8(key.data(),
+                                             Py_ssize_t(key.size()), nullptr);
+          if (!k || PyDict_SetItem(d, k, v) < 0) {
+            Py_XDECREF(k); Py_DECREF(v); Py_DECREF(d);
+            return nullptr;
+          }
+          Py_DECREF(k);
+          Py_DECREF(v);
+          ws();
+          if (p < end && *p == ',') { ++p; continue; }
+          if (p < end && *p == '}') { ++p; return d; }
+          fail("expected ',' or '}'");
+          Py_DECREF(d);
+          return nullptr;
+        }
+      }
+      case '[': {
+        ++p;
+        PyObject* l = PyList_New(0);
+        if (!l) return nullptr;
+        ws();
+        if (p < end && *p == ']') { ++p; return l; }
+        while (true) {
+          PyObject* v = value_py();
+          if (!v) { Py_DECREF(l); return nullptr; }
+          if (PyList_Append(l, v) < 0) {
+            Py_DECREF(v); Py_DECREF(l);
+            return nullptr;
+          }
+          Py_DECREF(v);
+          ws();
+          if (p < end && *p == ',') { ++p; continue; }
+          if (p < end && *p == ']') { ++p; return l; }
+          fail("expected ',' or ']'");
+          Py_DECREF(l);
+          return nullptr;
+        }
+      }
+      case '"': {
+        std::string s;
+        if (!string_raw(&s)) return nullptr;
+        return PyUnicode_DecodeUTF8(s.data(), Py_ssize_t(s.size()), nullptr);
+      }
+      case 't':
+        if (!lit("true")) return nullptr;
+        Py_RETURN_TRUE;
+      case 'f':
+        if (!lit("false")) return nullptr;
+        Py_RETURN_FALSE;
+      case 'n':
+        if (!lit("null")) return nullptr;
+        Py_RETURN_NONE;
+      default: {
+        // number: validate the JSON grammar, decide int vs float like
+        // Python's json
+        const char* start = p;
+        bool is_float;
+        if (!scan_number(&is_float)) return nullptr;
+        std::string tok(start, size_t(p - start));
+        if (is_float) {
+          return PyFloat_FromDouble(strtod(tok.c_str(), nullptr));
+        }
+        return PyLong_FromString(tok.c_str(), nullptr, 10);
+      }
+    }
+  }
+
+  // Validate-and-skip a JSON value textually (no Python objects built).
+  bool skip_value() {
+    ws();
+    if (p >= end) return fail("unexpected end");
+    switch (*p) {
+      case '{': {
+        ++p;
+        ws();
+        if (p < end && *p == '}') { ++p; return true; }
+        while (true) {
+          std::string key;
+          if (!string_raw(&key)) return false;
+          ws();
+          if (p >= end || *p != ':') return fail("expected ':'");
+          ++p;
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') { ++p; ws(); continue; }
+          if (p < end && *p == '}') { ++p; return true; }
+          return fail("expected ',' or '}'");
+        }
+      }
+      case '[': {
+        ++p;
+        ws();
+        if (p < end && *p == ']') { ++p; return true; }
+        while (true) {
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') { ++p; continue; }
+          if (p < end && *p == ']') { ++p; return true; }
+          return fail("expected ',' or ']'");
+        }
+      }
+      case '"': {
+        std::string s;
+        return string_raw(&s);
+      }
+      case 't':
+        return lit("true");
+      case 'f':
+        return lit("false");
+      case 'n':
+        return lit("null");
+      default: {
+        bool is_float;
+        return scan_number(&is_float);
+      }
+    }
+  }
+
+  // ---- operations ----
+  bool path_field(std::vector<int64_t>* out) {
+    ws();
+    if (p >= end || *p != '[') return fail("expected path list");
+    ++p;
+    out->clear();
+    ws();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      int64_t v;
+      if (!int64_field(&v)) return false;
+      out->push_back(v);
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return true; }
+      return fail("expected ',' or ']' in path");
+    }
+  }
+
+  bool emit(Columns* c, int8_t kind, int64_t ts,
+            const std::vector<int64_t>& path, PyObject* val) {
+    int D = c->max_depth;
+    if (int(path.size()) > D) {
+      return fail("path depth " + std::to_string(path.size()) +
+                  " exceeds max_depth " + std::to_string(D));
+    }
+    for (int64_t e : path) {
+      if (e < 0 || e >= MAX_TS) return fail("path element out of range");
+    }
+    if (kind == 0 && (ts < 0 || ts >= MAX_TS)) {
+      return fail("timestamp out of range");
+    }
+    c->kind.push_back(kind);
+    c->depth.push_back(int32_t(path.size()));
+    int64_t last = path.empty() ? 0 : path.back();
+    int64_t par = path.size() >= 2 ? path[path.size() - 2] : 0;
+    c->parent.push_back(par);
+    if (kind == 0) {  // add
+      c->ts.push_back(ts);
+      c->anchor.push_back(last);
+      c->value_ref.push_back(int32_t(PyList_GET_SIZE(c->values)));
+      if (PyList_Append(c->values, val) < 0) return false;
+    } else {  // delete
+      c->ts.push_back(last);
+      c->anchor.push_back(last);
+      c->value_ref.push_back(-1);
+    }
+    size_t row = c->paths.size();
+    c->paths.resize(row + size_t(D), 0);
+    std::memcpy(c->paths.data() + row, path.data(),
+                path.size() * sizeof(int64_t));
+    return true;
+  }
+
+  // One operation object; flattens batches depth-first.  Duplicate keys
+  // follow JSON object semantics (last occurrence wins, matching Python's
+  // json.loads): fields are collected with overwrite, the raw "ops" span
+  // is remembered rather than parsed inline, and leaves are emitted only
+  // after the object closes — so the final tag governs and only the final
+  // "ops" list contributes.
+  bool operation(Columns* c, int depth_guard) {
+    if (depth_guard > 512) return fail("batch nesting too deep");
+    ws();
+    if (p >= end || *p != '{') return fail("expected operation object");
+    ++p;
+    bool has_op = false, has_ts = false, has_path = false, has_val = false;
+    std::string tag;
+    int64_t ts = 0;
+    std::vector<int64_t> path;
+    PyObject* val = nullptr;
+    const char* ops_span = nullptr;   // raw span of the last "ops" value
+    const char* ops_span_end = nullptr;
+    bool ok = true;
+    bool done = false;
+    ws();
+    if (p < end && *p == '}') { ++p; ok = fail("missing 'op' tag"); done = true; }
+    while (ok && !done) {
+      std::string key;
+      if (!(ok = string_raw(&key))) break;
+      ws();
+      if (p >= end || *p != ':') { ok = fail("expected ':'"); break; }
+      ++p;
+      if (key == "op") {
+        if (!(ok = string_raw(&tag))) break;
+        has_op = true;
+      } else if (key == "ts") {
+        if (!(ok = int64_field(&ts))) break;
+        has_ts = true;
+      } else if (key == "path") {
+        if (!(ok = path_field(&path))) break;
+        has_path = true;
+      } else if (key == "val") {
+        Py_XDECREF(val);
+        val = value_py();
+        if (!val) { ok = false; break; }
+        has_val = true;
+      } else if (key == "ops") {
+        ws();
+        ops_span = p;
+        if (!(ok = skip_value())) break;
+        ops_span_end = p;
+      } else {
+        if (!(ok = skip_value())) break;
+      }
+      ws();
+      if (p < end && *p == ',') { ++p; ws(); continue; }
+      if (p < end && *p == '}') { ++p; done = true; break; }
+      ok = fail("expected ',' or '}'");
+      break;
+    }
+    if (ok) {
+      if (!has_op) {
+        ok = fail("missing 'op' tag");
+      } else if (tag == "add") {
+        if (!has_ts || !has_path || !has_val) {
+          ok = fail("malformed add (need ts, path, val)");
+        } else {
+          ok = emit(c, 0, ts, path, val);
+        }
+      } else if (tag == "del") {
+        if (!has_path) {
+          ok = fail("malformed del (need path)");
+        } else {
+          ok = emit(c, 1, 0, path, nullptr);
+        }
+      } else if (tag == "batch") {
+        if (ops_span == nullptr) {
+          // {"op":"batch"} without ops is malformed in the reference
+          ok = fail("malformed batch (need ops)");
+        } else {
+          // re-parse the remembered span as the list of child operations
+          const char* save_p = p;
+          const char* save_end = end;
+          p = ops_span;
+          end = ops_span_end;
+          ok = ops_list(c, depth_guard);
+          if (ok) {
+            ws();
+            if (p != end) ok = fail("trailing data in ops");
+          }
+          p = save_p;
+          end = save_end;
+        }
+      }
+      // unknown tag: forward-compatible no-op, nothing emitted
+    }
+    Py_XDECREF(val);
+    return ok;
+  }
+
+  bool ops_list(Columns* c, int depth_guard) {
+    ws();
+    if (p >= end || *p != '[') return fail("expected ops list");
+    ++p;
+    ws();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      if (!operation(c, depth_guard + 1)) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return true; }
+      return fail("expected ',' or ']' in ops");
+    }
+  }
+};
+
+PyObject* bytes_from(const void* data, size_t nbytes) {
+  return PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                   Py_ssize_t(nbytes));
+}
+
+PyObject* parse_pack(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  int max_depth;
+  if (!PyArg_ParseTuple(args, "y*i", &buf, &max_depth)) return nullptr;
+  if (max_depth <= 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "max_depth must be positive");
+    return nullptr;
+  }
+  Columns cols;
+  cols.max_depth = max_depth;
+  cols.values = PyList_New(0);
+  if (!cols.values) { PyBuffer_Release(&buf); return nullptr; }
+
+  Parser parser(static_cast<const char*>(buf.buf), buf.len);
+  bool ok = parser.operation(&cols, 0);
+  if (ok) {
+    parser.ws();
+    if (parser.p != parser.end) ok = parser.fail("trailing data");
+  }
+  PyBuffer_Release(&buf);
+  if (!ok) {
+    Py_DECREF(cols.values);
+    if (!PyErr_Occurred()) {
+      PyErr_SetString(PyExc_ValueError, parser.err.c_str());
+    }
+    return nullptr;
+  }
+
+  size_t n = cols.kind.size();
+  PyObject* out = Py_BuildValue(
+      "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:n}",
+      "kind", bytes_from(cols.kind.data(), n),
+      "ts", bytes_from(cols.ts.data(), n * 8),
+      "parent_ts", bytes_from(cols.parent.data(), n * 8),
+      "anchor_ts", bytes_from(cols.anchor.data(), n * 8),
+      "depth", bytes_from(cols.depth.data(), n * 4),
+      "value_ref", bytes_from(cols.value_ref.data(), n * 4),
+      "paths", bytes_from(cols.paths.data(), n * size_t(max_depth) * 8),
+      "n", Py_ssize_t(n));
+  if (!out) { Py_DECREF(cols.values); return nullptr; }
+  if (PyDict_SetItemString(out, "values", cols.values) < 0) {
+    Py_DECREF(cols.values);
+    Py_DECREF(out);
+    return nullptr;
+  }
+  Py_DECREF(cols.values);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"parse_pack", parse_pack, METH_VARARGS,
+     "parse_pack(payload: bytes, max_depth: int) -> dict of packed columns"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastcodec",
+    "Native JSON wire codec for crdt_graph_tpu", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastcodec(void) {
+  return PyModule_Create(&moduledef);
+}

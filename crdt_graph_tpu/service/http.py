@@ -87,8 +87,11 @@ def make_handler(store: DocumentStore):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
-            # validate route and body BEFORE store.get(create=True), so
-            # invalid requests never materialize documents
+            # always drain the request body first (keep-alive connections
+            # would otherwise read leftover body bytes as the next request
+            # line), and validate the route BEFORE store.get(create=True)
+            # so invalid requests never materialize documents
+            body = self._body()
             doc_id, sub, _ = self._route()
             if doc_id is None or sub not in ("/replicas", "/ops"):
                 self._send(404, {"error": "not found"})
@@ -98,7 +101,7 @@ def make_handler(store: DocumentStore):
                            {"replica": store.get(doc_id).assign_replica()})
                 return
             try:
-                op = store.decode_ops(self._body())
+                op = store.decode_ops(body)
             except (DecodeError, json.JSONDecodeError) as e:
                 self._send(400, {"error": str(e)})
                 return

@@ -77,8 +77,7 @@ class Document:
                 "dup_absorbed": self.dup_absorbed,
                 "batches_rejected": self.batches_rejected,
                 "num_visible": len(self.tree),
-                "log_length": len(op_mod.to_list(
-                    self.tree.operations_since(0))),
+                "log_length": self.tree.log_length,
                 "replicas_assigned": self.next_replica - 1,
             }
 

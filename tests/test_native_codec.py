@@ -1,0 +1,148 @@
+"""Native codec differential suite: _fastcodec.parse_pack must agree with
+the pure-Python json_codec.loads → pack path on every input — same columns,
+same values, same rejections."""
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu import native
+from crdt_graph_tpu.codec import json_codec, packed
+from crdt_graph_tpu.core import operation as op_mod
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+def assert_same(payload, max_depth=16):
+    want = packed.pack(json_codec.loads(payload), max_depth=max_depth)
+    got = native.parse_pack(payload, max_depth=max_depth)
+    assert got.num_ops == want.num_ops
+    for f in ("kind", "ts", "parent_ts", "anchor_ts", "depth", "paths",
+              "value_ref", "pos"):
+        np.testing.assert_array_equal(getattr(got, f), getattr(want, f), f)
+    assert got.values == want.values
+    return got
+
+
+def test_golden_fixtures():
+    # the JsonTest.elm shapes: add, del, batch
+    assert_same('{"op":"add","path":[0,1],"ts":2,"val":"a"}')
+    assert_same('{"op":"del","path":[1,2,3]}')
+    assert_same('{"op":"batch","ops":['
+                '{"op":"add","path":[0],"ts":1,"val":"x"},'
+                '{"op":"del","path":[1]}]}')
+
+
+def test_nested_batches_flatten_in_order():
+    assert_same('{"op":"batch","ops":[{"op":"batch","ops":['
+                '{"op":"add","path":[0],"ts":1,"val":1}]},'
+                '{"op":"add","path":[1],"ts":2,"val":2},'
+                '{"op":"batch","ops":[]}]}')
+
+
+def test_unknown_tag_is_noop():
+    got = assert_same('{"op":"mystery","path":[1]}')
+    assert got.num_ops == 0
+    assert_same('{"op":"batch","ops":[{"op":"future","x":[{"y":1}]},'
+                '{"op":"add","path":[0],"ts":5,"val":null}]}')
+
+
+def test_value_payload_types():
+    vals = ["str", "", "unié中😀", 0, -5, 2**40, 1.5,
+            -0.25, 1e10, True, False, None, [1, [2, "x"]],
+            {"k": {"n": None, "l": [1.0]}}, "esc\"\\\n\t/"]
+    ops = crdt.Batch(tuple(crdt.Add(i + 1, (i,), v)
+                           for i, v in enumerate(vals)))
+    payload = json_codec.dumps(ops)
+    got = assert_same(payload)
+    assert got.values == list(vals)
+
+
+def test_whitespace_tolerated():
+    assert_same('  {  "op" : "add" , "path" : [ 0 , 1 ] , "ts" : 2 , '
+                '"val" : { "a" : [ 1 , 2 ] } }  ')
+
+
+def test_random_session_payloads():
+    from test_merge_kernel import _random_session
+    for seed in (31, 32):
+        merged, ops = _random_session(seed, n_replicas=3, steps=80)
+        payload = json_codec.dumps(op_mod.from_list(ops))
+        assert_same(payload)
+
+
+@pytest.mark.parametrize("bad", [
+    '{"op":"add","path":[0]}',                   # missing ts/val
+    '{"op":"add","ts":1,"val":1}',               # missing path
+    '{"op":"add","path":[0],"ts":1.5,"val":1}',  # float ts
+    '{"op":"add","path":[0.5],"ts":1,"val":1}',  # float path elem
+    '{"op":"add","path":[0],"ts":true,"val":1}',  # bool ts
+    '{"op":"del"}',                              # missing path
+    '{"op":"batch"}',                            # missing ops
+    '{"op":"batch","ops":{}}',                   # ops not a list
+    '{"path":[0],"ts":1,"val":1}',               # missing tag
+    '{"op":"add","path":[0],"ts":4611686018427387905,"val":1}',  # >= 2^62
+    'noise',
+    '{"op":"add","path":[0],"ts":1,"val":1} trailing',
+])
+def test_rejections_match_python(bad):
+    with pytest.raises(ValueError):
+        native.parse_pack(bad)
+    with pytest.raises(ValueError):
+        packed.pack(json_codec.loads(bad))
+
+
+def test_merge_from_native_pack_matches_oracle():
+    from crdt_graph_tpu.ops import merge, view
+    from test_merge_kernel import _random_session
+    merged, ops = _random_session(33, n_replicas=4, steps=100)
+    payload = json_codec.dumps(op_mod.from_list(ops))
+    p = packed.pack_json(payload)
+    t = view.to_host(merge.materialize(p.arrays()))
+    assert view.visible_values(t, p.values) == merged.visible_values()
+
+
+def test_big_int_and_unicode_roundtrip():
+    # int64 extremes inside protocol range and astral-plane text
+    ts = (2**30 - 1) * 2**32 + 7
+    payload = json.dumps({"op": "add", "path": [0], "ts": ts,
+                          "val": "\U0001F680 ß"})
+    got = assert_same(payload)
+    assert got.ts[0] == ts
+
+
+def test_duplicate_keys_last_wins_like_python():
+    # duplicate "ops": only the last list contributes (json.loads semantics)
+    assert_same('{"op":"batch","ops":['
+                '{"op":"add","path":[0],"ts":1,"val":"A"}],'
+                '"ops":[{"op":"add","path":[0],"ts":2,"val":"B"}]}')
+    # duplicate "ts"/"val": last wins
+    assert_same('{"op":"add","path":[0],"ts":1,"ts":2,'
+                '"val":"x","val":"y"}')
+    # tag flips after fields: final tag governs
+    assert_same('{"op":"del","path":[3],"op":"add","ts":9,"val":1}'
+                .replace('"op":"add","ts"', '"op":"add","path":[0],"ts"'))
+
+
+@pytest.mark.parametrize("bad", [
+    '{"op":"del","path":[01]}',                     # leading zero
+    '{"op":"add","path":[0],"ts":1,"val":1.}',      # trailing dot
+    '{"op":"add","path":[0],"ts":1,"val":.5}',      # leading dot
+    '{"op":"add","path":[0],"ts":1,"val":1.0e}',    # empty exponent
+    '{"op":"add","path":[0],"ts":1,"val":00}',      # leading zero int
+    '{"op":"add","path":[0],"ts":01,"val":1}',      # leading zero ts
+])
+def test_number_grammar_rejections_match_python(bad):
+    with pytest.raises(ValueError):
+        native.parse_pack(bad)
+    with pytest.raises(ValueError):
+        packed.pack(json_codec.loads(bad))
+
+
+def test_error_offsets_are_real():
+    with pytest.raises(ValueError, match="offset (?!0\\b)"):
+        native.parse_pack('{"op":"add","path":[0],"ts":1,"val":1} x')
