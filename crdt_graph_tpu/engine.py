@@ -368,6 +368,54 @@ class TpuTree:
         tree._last_operation = json_codec.decode(state["last_operation"])
         return tree
 
+    def checkpoint_packed(self, path: str) -> None:
+        """Binary checkpoint: the packed op columns plus clocks, written
+        with numpy — the fast path for big logs (no per-op JSON).  Values
+        must be JSON-encodable (they ride in one JSON sidecar field).
+        Written to exactly ``path`` (a file handle sidesteps numpy's
+        .npz-suffix appending)."""
+        import json
+        from .codec import json_codec
+        p = self._ensure_packed()
+        meta = {
+            "replica": self._replica,
+            "timestamp": self._timestamp,
+            "cursor": list(self._cursor),
+            "replicas": {str(k): v for k, v in self._replicas.items()},
+            "max_depth": self._max_depth,
+            "num_ops": p.num_ops,
+            "last_operation": json_codec.encode(self._last_operation),
+        }
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f, kind=p.kind, ts=p.ts, parent_ts=p.parent_ts,
+                anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
+                value_ref=p.value_ref, pos=p.pos,
+                values=np.frombuffer(json.dumps(p.values).encode(),
+                                     np.uint8),
+                meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
+
+    @staticmethod
+    def restore_packed(path: str) -> "TpuTree":
+        import json
+        from .codec import json_codec
+        z = np.load(path)
+        meta = json.loads(bytes(z["meta"]).decode())
+        p = PackedOps(
+            kind=z["kind"], ts=z["ts"], parent_ts=z["parent_ts"],
+            anchor_ts=z["anchor_ts"], depth=z["depth"], paths=z["paths"],
+            value_ref=z["value_ref"], pos=z["pos"],
+            values=json.loads(bytes(z["values"]).decode()),
+            num_ops=meta["num_ops"])
+        tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
+        tree._log = packed_mod.unpack(p)
+        tree._packed = p
+        tree._timestamp = meta["timestamp"]
+        tree._cursor = tuple(meta["cursor"])
+        tree._replicas = {int(k): v for k, v in meta["replicas"].items()}
+        tree._last_operation = json_codec.decode(meta["last_operation"])
+        return tree
+
 
 def init(replica: int, max_depth: int = DEFAULT_MAX_DEPTH) -> TpuTree:
     """Build a TPU-engine replica (API parity with core.tree.init)."""

@@ -22,6 +22,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <vector>
 
@@ -184,17 +185,18 @@ struct Parser {
             if (!hex4(&cp)) return false;
             if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
               if (end - p >= 2 && p[0] == '\\' && p[1] == 'u') {
+                const char* save = p;
                 p += 2;
                 unsigned lo;
                 if (!hex4(&lo)) return false;
                 if (lo >= 0xDC00 && lo <= 0xDFFF) {
                   cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
                 } else {
-                  return fail("bad surrogate pair");
+                  p = save;  // lone high surrogate kept, like json.loads
                 }
-              } else {
-                return fail("lone surrogate");
               }
+              // lone surrogates encode as WTF-8; decoded with
+              // "surrogatepass" below, matching Python's json.loads
             }
             append_utf8(*out, cp);
             break;
@@ -235,8 +237,8 @@ struct Parser {
           ++p;
           PyObject* v = value_py();
           if (!v) { Py_DECREF(d); return nullptr; }
-          PyObject* k = PyUnicode_DecodeUTF8(key.data(),
-                                             Py_ssize_t(key.size()), nullptr);
+          PyObject* k = PyUnicode_DecodeUTF8(
+              key.data(), Py_ssize_t(key.size()), "surrogatepass");
           if (!k || PyDict_SetItem(d, k, v) < 0) {
             Py_XDECREF(k); Py_DECREF(v); Py_DECREF(d);
             return nullptr;
@@ -276,7 +278,8 @@ struct Parser {
       case '"': {
         std::string s;
         if (!string_raw(&s)) return nullptr;
-        return PyUnicode_DecodeUTF8(s.data(), Py_ssize_t(s.size()), nullptr);
+        return PyUnicode_DecodeUTF8(s.data(), Py_ssize_t(s.size()),
+                                    "surrogatepass");
       }
       case 't':
         if (!lit("true")) return nullptr;
@@ -287,9 +290,20 @@ struct Parser {
       case 'n':
         if (!lit("null")) return nullptr;
         Py_RETURN_NONE;
+      case 'N':
+        if (!lit("NaN")) return nullptr;
+        return PyFloat_FromDouble(std::numeric_limits<double>::quiet_NaN());
+      case 'I':
+        if (!lit("Infinity")) return nullptr;
+        return PyFloat_FromDouble(std::numeric_limits<double>::infinity());
       default: {
         // number: validate the JSON grammar, decide int vs float like
-        // Python's json
+        // Python's json (which also accepts -Infinity)
+        if (*p == '-' && p + 1 < end && p[1] == 'I') {
+          if (!lit("-Infinity")) return nullptr;
+          return PyFloat_FromDouble(
+              -std::numeric_limits<double>::infinity());
+        }
         const char* start = p;
         bool is_float;
         if (!scan_number(&is_float)) return nullptr;
@@ -346,7 +360,14 @@ struct Parser {
         return lit("false");
       case 'n':
         return lit("null");
+      case 'N':
+        return lit("NaN");
+      case 'I':
+        return lit("Infinity");
       default: {
+        if (*p == '-' && p + 1 < end && p[1] == 'I') {
+          return lit("-Infinity");
+        }
         bool is_float;
         return scan_number(&is_float);
       }
@@ -418,13 +439,18 @@ struct Parser {
     ws();
     if (p >= end || *p != '{') return fail("expected operation object");
     ++p;
-    bool has_op = false, has_ts = false, has_path = false, has_val = false;
+    // Every field is grammar-validated as generic JSON during the object
+    // walk (matching json.loads, which parses the whole document before
+    // any semantic check); "ts"/"path"/"ops" are remembered as raw spans
+    // and re-parsed with the tag's SEMANTIC rules only after the object
+    // closes and the final tag is known.  Unknown tags therefore tolerate
+    // arbitrary field contents, exactly like the Python decoder.
+    bool has_op = false, has_val = false;
     std::string tag;
-    int64_t ts = 0;
-    std::vector<int64_t> path;
     PyObject* val = nullptr;
-    const char* ops_span = nullptr;   // raw span of the last "ops" value
-    const char* ops_span_end = nullptr;
+    const char* ts_span = nullptr, *ts_span_end = nullptr;
+    const char* path_span = nullptr, *path_span_end = nullptr;
+    const char* ops_span = nullptr, *ops_span_end = nullptr;
     bool ok = true;
     bool done = false;
     ws();
@@ -439,11 +465,15 @@ struct Parser {
         if (!(ok = string_raw(&tag))) break;
         has_op = true;
       } else if (key == "ts") {
-        if (!(ok = int64_field(&ts))) break;
-        has_ts = true;
+        ws();
+        ts_span = p;
+        if (!(ok = skip_value())) break;
+        ts_span_end = p;
       } else if (key == "path") {
-        if (!(ok = path_field(&path))) break;
-        has_path = true;
+        ws();
+        path_span = p;
+        if (!(ok = skip_value())) break;
+        path_span_end = p;
       } else if (key == "val") {
         Py_XDECREF(val);
         val = value_py();
@@ -467,39 +497,55 @@ struct Parser {
       if (!has_op) {
         ok = fail("missing 'op' tag");
       } else if (tag == "add") {
-        if (!has_ts || !has_path || !has_val) {
+        int64_t ts = 0;
+        std::vector<int64_t> path;
+        if (ts_span == nullptr || path_span == nullptr || !has_val) {
           ok = fail("malformed add (need ts, path, val)");
         } else {
-          ok = emit(c, 0, ts, path, val);
+          ok = reparse(ts_span, ts_span_end,
+                       [&] { return int64_field(&ts); }) &&
+               reparse(path_span, path_span_end,
+                       [&] { return path_field(&path); }) &&
+               emit(c, 0, ts, path, val);
         }
       } else if (tag == "del") {
-        if (!has_path) {
+        std::vector<int64_t> path;
+        if (path_span == nullptr) {
           ok = fail("malformed del (need path)");
         } else {
-          ok = emit(c, 1, 0, path, nullptr);
+          ok = reparse(path_span, path_span_end,
+                       [&] { return path_field(&path); }) &&
+               emit(c, 1, 0, path, nullptr);
         }
       } else if (tag == "batch") {
         if (ops_span == nullptr) {
           // {"op":"batch"} without ops is malformed in the reference
           ok = fail("malformed batch (need ops)");
         } else {
-          // re-parse the remembered span as the list of child operations
-          const char* save_p = p;
-          const char* save_end = end;
-          p = ops_span;
-          end = ops_span_end;
-          ok = ops_list(c, depth_guard);
-          if (ok) {
-            ws();
-            if (p != end) ok = fail("trailing data in ops");
-          }
-          p = save_p;
-          end = save_end;
+          ok = reparse(ops_span, ops_span_end,
+                       [&] { return ops_list(c, depth_guard); });
         }
       }
       // unknown tag: forward-compatible no-op, nothing emitted
     }
     Py_XDECREF(val);
+    return ok;
+  }
+
+  // Run ``body`` against a remembered [s, e) span, restoring the cursor.
+  template <typename F>
+  bool reparse(const char* s, const char* e, F body) {
+    const char* save_p = p;
+    const char* save_end = end;
+    p = s;
+    end = e;
+    bool ok = body();
+    if (ok) {
+      ws();
+      if (p != end) ok = fail("trailing data in field");
+    }
+    p = save_p;
+    end = save_end;
     return ok;
   }
 

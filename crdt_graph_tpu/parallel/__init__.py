@@ -6,3 +6,6 @@ __all__ = [
     "DOCS_AXIS", "OPS_AXIS", "batched_materialize", "make_mesh",
     "sharded_materialize", "stack_packed",
 ]
+from . import distributed  # noqa: E402  (multi-host helpers)
+
+__all__.append("distributed")

@@ -1,0 +1,81 @@
+"""Multi-host distribution: the DCN story above the single-host mesh.
+
+The reference's "distributed backend" is a protocol contract carried by an
+application network (SURVEY §2b); within one TPU pod slice, ICI collectives
+replace it (parallel/mesh.py).  Across hosts, this module provides the
+standard JAX multi-controller setup: every host runs the same program,
+``jax.distributed.initialize`` wires them into one runtime, and arrays are
+assembled from per-host shards so each host feeds only its local documents
+(the docs axis spans the fleet; XLA routes any cross-host collectives over
+DCN).
+
+On a single host everything degrades to the local mesh — ``initialize`` is
+skipped and ``global_device_mesh`` is exactly ``make_mesh``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DOCS_AXIS, OPS_AXIS
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime; no-op for single-process runs.
+
+    Call once at startup on every host, before any device computation:
+    ``initialize("host0:1234", num_processes=N, process_id=k)``.  With no
+    arguments, auto-detects cluster env (TPU pod metadata) and falls back
+    to single-process when there is none.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except (ValueError, RuntimeError):
+        if coordinator_address is not None or num_processes is not None:
+            # the caller explicitly asked for a cluster — a silent
+            # single-process fallback would shard the fleet wrongly
+            raise
+        # bare auto-detect on a non-cluster machine: nothing to do
+
+
+def global_device_mesh(n_ops: int = 1) -> Mesh:
+    """A ``(docs, ops)`` mesh over EVERY device in the fleet (all hosts).
+
+    The docs axis spans hosts (document merges never communicate, so DCN
+    carries no merge traffic); the ops axis should stay within a host's
+    devices so op-axis collectives ride ICI — keep ``n_ops`` ≤ local
+    device count.
+    """
+    devices = jax.devices()
+    if len(devices) % n_ops != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"n_ops={n_ops}")
+    grid = np.asarray(devices).reshape(len(devices) // n_ops, n_ops)
+    return Mesh(grid, (DOCS_AXIS, OPS_AXIS))
+
+
+def host_local_docs_to_global(ops: Dict[str, np.ndarray],
+                              mesh: Mesh) -> Dict[str, jax.Array]:
+    """Assemble a fleet-wide batch from per-host document shards.
+
+    Each host passes the packed ``[B_local, N]`` arrays of its own
+    documents; the result is one global ``[B_global, N]`` array sharded
+    over the mesh's docs axis, ready for ``batched_materialize``'s kernel
+    (every host computes only its shard).
+    """
+    spec = P(DOCS_AXIS)
+    return {
+        k: jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), v)
+        for k, v in ops.items()
+    }

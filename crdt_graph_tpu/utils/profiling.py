@@ -1,0 +1,75 @@
+"""Profiling and observability harness (SURVEY §5: the reference has none;
+the TPU framework owes timing + tracing around its merge path).
+
+- :func:`timed` — wall-clock statistics for any jitted callable, with
+  ``block_until_ready`` on the result (the only honest way to time XLA).
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace directory.
+- :func:`table_stats` — structural summary of a merged NodeTable
+  (fan-out, depth, tombstone load) for capacity planning and debugging.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+import jax
+
+
+def timed(fn: Callable[..., Any], *args, repeats: int = 5,
+          warmup: int = 1) -> Dict[str, float]:
+    """Run ``fn(*args)`` with warmup, return ms timing stats."""
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(max(1, warmup)):
+        out = jax.block_until_ready(fn(*args))
+    first = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "p50_ms": times[len(times) // 2] * 1e3,
+        "min_ms": times[0] * 1e3,
+        "max_ms": times[-1] * 1e3,
+        "warmup_ms": first * 1e3,
+        "result": out,
+    }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``with trace("/tmp/tb"):`` captures a jax.profiler trace."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def table_stats(table) -> Dict[str, Any]:
+    """Structural summary of a (host) NodeTable."""
+    exists = np.asarray(table.exists)
+    depth = np.asarray(table.depth)[exists]
+    parent = np.asarray(table.parent)[exists]
+    tomb = np.asarray(table.tombstone)[exists]
+    dead = np.asarray(table.dead)[exists]
+    n = int(exists.sum())
+    if n == 0:
+        return {"nodes": 0, "visible": 0}
+    fanout = np.bincount(parent)
+    return {
+        "nodes": n,
+        "visible": int(np.asarray(table.num_visible)),
+        "tombstones": int(tomb.sum()),
+        "dead": int(dead.sum()),
+        "max_depth": int(depth.max()),
+        "mean_depth": float(depth.mean()),
+        "max_fanout": int(fanout.max()),
+        "tombstone_ratio": float(tomb.sum() / n),
+    }

@@ -146,3 +146,26 @@ def test_number_grammar_rejections_match_python(bad):
 def test_error_offsets_are_real():
     with pytest.raises(ValueError, match="offset (?!0\\b)"):
         native.parse_pack('{"op":"add","path":[0],"ts":1,"val":1} x')
+
+
+def test_semantic_checks_wait_for_final_tag():
+    # unknown tags tolerate arbitrary field contents (json.loads parses,
+    # decode ignores) — native must accept these too
+    got = assert_same('{"op":"mystery","ts":1.5,"path":{"x":1}}')
+    assert got.num_ops == 0
+    # del ignores a float ts field entirely
+    assert_same('{"op":"del","path":[0],"ts":2.5}'
+                .replace('"path":[0]', '"path":[7]')
+                .replace('[7]', '[0]'))
+
+
+def test_python_json_extensions_accepted():
+    # json.loads accepts NaN/Infinity/-Infinity and lone surrogates in
+    # value payloads; parity demands the native parser does too
+    got = assert_same('{"op":"add","path":[0],"ts":1,'
+                      '"val":[Infinity,-Infinity]}')
+    assert got.values == [[float("inf"), float("-inf")]]
+    got = native.parse_pack('{"op":"add","path":[0],"ts":1,"val":NaN}')
+    assert math.isnan(got.values[0])
+    assert_same('{"op":"add","path":[0],"ts":1,"val":"\\ud800"}')
+    assert_same('{"op":"add","path":[0],"ts":1,"val":"\\ud800\\udc00x"}')
