@@ -74,6 +74,11 @@ class CRDTree:
     def replica_id(self) -> int:
         return ts_mod.replica_id(self.timestamp)
 
+    @property
+    def id(self) -> int:
+        """Reference-named alias of :attr:`replica_id` (CRDTree.elm `id`)."""
+        return self.replica_id
+
     def next_timestamp(self) -> int:
         return self.timestamp + 1
 

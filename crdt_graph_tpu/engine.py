@@ -37,6 +37,90 @@ from .ops import view as view_mod
 from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
 
 
+class StaleNodeView(RuntimeError):
+    """A TableNode outlived the table it points into.
+
+    Unlike the oracle's persistent nodes, engine views index a mutable
+    table whose slots are reassigned on every merge; using a view across
+    an edit would silently read a DIFFERENT node, so it fails loudly
+    instead.  Re-fetch with ``tree.get(node.path)``."""
+
+
+class TableNode:
+    """Read-only node view over the materialised table — the engine-side
+    counterpart of the oracle ``Node`` facade (CRDTree/Node.elm): value,
+    timestamp, path accessors and visible-children traversal, resolved
+    directly from the array table without building a pointer tree.
+
+    Views are tied to one materialisation: any subsequent edit/merge
+    invalidates them (see :class:`StaleNodeView`)."""
+
+    __slots__ = ("_tree", "_slot", "_gen")
+
+    def __init__(self, tree: "TpuTree", slot: int):
+        self._tree = tree
+        self._slot = slot
+        self._gen = tree._generation
+
+    def _check(self) -> None:
+        if self._gen != self._tree._generation:
+            raise StaleNodeView(
+                "node view predates the last edit/merge; re-fetch it with "
+                "tree.get(path)")
+
+    def _col(self, name: str):
+        self._check()
+        return np.asarray(getattr(self._tree.table(), name))
+
+    @property
+    def timestamp(self) -> int:
+        return int(self._col("ts")[self._slot]) if not self.is_root else 0
+
+    @property
+    def path(self) -> Tuple[int, ...]:
+        d = int(self._col("depth")[self._slot])
+        return tuple(int(x) for x in self._col("paths")[self._slot, :d])
+
+    @property
+    def is_root(self) -> bool:
+        return self._slot == 0
+
+    @property
+    def is_deleted(self) -> bool:
+        return bool(self._col("tombstone")[self._slot])
+
+    @property
+    def value(self) -> Any:
+        """Value unless deleted or root (CRDTree/Node.elm:198-202)."""
+        if self.is_root or self.is_deleted:
+            return None
+        ref = int(self._col("value_ref")[self._slot])
+        return self._tree._ensure_packed().values[ref]
+
+    def children(self) -> List["TableNode"]:
+        """Visible children in document order."""
+        t = self._tree.table()
+        mask = np.asarray(t.visible) & \
+            (np.asarray(t.parent) == self._slot) & \
+            (np.arange(np.asarray(t.parent).shape[0]) != self._slot)
+        slots = np.nonzero(mask)[0]
+        slots = slots[np.argsort(np.asarray(t.doc_index)[slots])]
+        return [TableNode(self._tree, int(s)) for s in slots]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, TableNode) and other._slot == self._slot \
+            and other._tree is self._tree
+
+    def __hash__(self) -> int:
+        return hash((id(self._tree), self._slot))
+
+    def __repr__(self) -> str:
+        if self.is_root:
+            return "TableNode(root)"
+        return (f"TableNode(ts={self.timestamp}, path={self.path}, "
+                f"value={self.value!r})")
+
+
 class TpuTree:
     """Array-backed replica.  See module docstring."""
 
@@ -55,6 +139,11 @@ class TpuTree:
 
     @property
     def replica_id(self) -> int:
+        return self._replica
+
+    @property
+    def id(self) -> int:
+        """Reference-named alias of :attr:`replica_id` (CRDTree.elm `id`)."""
         return self._replica
 
     @property
@@ -303,6 +392,102 @@ class TpuTree:
         """Visible values in document order — the render path."""
         table = self.table()
         return view_mod.visible_values(table, self._ensure_packed().values)
+
+    # -- node views and traversal (parity: CRDTree.elm:423-625) -----------
+
+    def root(self) -> TableNode:
+        return TableNode(self, 0)
+
+    def get(self, path: Sequence[int]) -> Optional[TableNode]:
+        """Node at ``path`` (tombstones included) or None."""
+        slot = self._slot_at(tuple(path))
+        return TableNode(self, slot) if slot is not None else None
+
+    def parent(self, node: TableNode) -> Optional[TableNode]:
+        """Parent of a node; the root for depth-1 nodes."""
+        if node.is_root:
+            return None
+        p = int(np.asarray(self.table().parent)[node._slot])
+        return TableNode(self, p)
+
+    def _siblings(self, node: TableNode) -> np.ndarray:
+        """Existing same-branch siblings (incl. tombstones), doc order."""
+        t = self.table()
+        parent = np.asarray(t.parent)
+        mask = np.asarray(t.exists) & (parent == parent[node._slot])
+        slots = np.nonzero(mask)[0]
+        return slots[np.argsort(np.asarray(t.doc_index)[slots])]
+
+    def next(self, node: TableNode) -> Optional[TableNode]:
+        """Next visible sibling (CRDTree.elm:563-568)."""
+        sibs = self._siblings(node)
+        visible = np.asarray(self.table().visible)
+        after = sibs[np.nonzero(sibs == node._slot)[0][0] + 1:]
+        vis = after[visible[after]]
+        return TableNode(self, int(vis[0])) if vis.size else None
+
+    def prev(self, node: TableNode) -> Optional[TableNode]:
+        """Previous sibling, reference-faithfully (CRDTree.elm:573-577):
+        the first chain member whose next visible sibling is ``node`` —
+        the nearest visible predecessor when one exists, otherwise the
+        FIRST tombstone of a leading tombstone run (the reference's raw
+        ``find`` does not skip tombstone candidates)."""
+        sibs = self._siblings(node)
+        visible = np.asarray(self.table().visible)
+        before = sibs[:int(np.nonzero(sibs == node._slot)[0][0])]
+        if not before.size:
+            return None
+        vis = before[visible[before]]
+        if vis.size:
+            return TableNode(self, int(vis[-1]))
+        return TableNode(self, int(before[0]))
+
+    def _is_descendant(self, slot: int, ancestor: int) -> bool:
+        if ancestor == 0:
+            return slot != 0
+        parent = np.asarray(self.table().parent)
+        depth = np.asarray(self.table().depth)
+        cur = slot
+        for _ in range(int(depth[slot])):
+            cur = int(parent[cur])
+            if cur == ancestor:
+                return True
+            if cur == 0:
+                return False
+        return False
+
+    def walk(self, func: Callable[[TableNode, Any], Tuple[str, Any]],
+             acc: Any, start: Optional[TableNode] = None) -> Any:
+        """Resumable depth-first fold over visible nodes in document order
+        (CRDTree.elm:583-625) — pre-order IS document order, so the walk is
+        a linear scan of the visible ordering with early exit.  ``start``
+        is exclusive: the walk resumes after ``start``'s subtree and covers
+        the remainder of its sibling list (with full descents), matching
+        the oracle."""
+        t = self.table()
+        vis_order = np.asarray(t.visible_order)[:int(t.num_visible)]
+        if start is None or start.is_root:
+            for s in vis_order:
+                step, acc = func(TableNode(self, int(s)), acc)
+                if step == "done":
+                    return acc
+            return acc
+        doc_index = np.asarray(t.doc_index)
+        parent = np.asarray(t.parent)
+        p = int(parent[start._slot])
+        start_pos = int(doc_index[start._slot])
+        for s in vis_order:
+            s = int(s)
+            if doc_index[s] <= start_pos:
+                continue
+            if self._is_descendant(s, start._slot):
+                continue                      # still inside start's subtree
+            if not (p == 0 or self._is_descendant(s, p)):
+                break                         # left parent(start)'s subtree
+            step, acc = func(TableNode(self, s), acc)
+            if step == "done":
+                return acc
+        return acc
 
     def visible_paths(self) -> List[tuple]:
         return view_mod.visible_paths(self.table())

@@ -1,0 +1,108 @@
+"""Engine node-view/traversal API (TableNode) vs the oracle: get, parent,
+next, prev, walk, children must agree on randomized sessions — a reference
+user switching engines finds the same surface with the same answers."""
+import random
+
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu import engine
+
+from test_merge_kernel import _random_session
+
+
+@pytest.fixture(params=[0, 1, 2])
+def pair(request):
+    merged, ops = _random_session(request.param + 60, n_replicas=3,
+                                  steps=70)
+    e = engine.init(42)
+    e.apply(crdt.Batch(tuple(ops)))
+    o = crdt.init(42).apply(crdt.Batch(tuple(ops)))
+    return e, o
+
+
+def all_paths(o):
+    acc = []
+    o.walk(lambda n, a: ("take", a.append(n.path) or a), acc)
+    return acc
+
+
+def test_walk_matches_oracle(pair):
+    e, o = pair
+    assert [n.path for n in walk_nodes(e)] == all_paths(o)
+
+
+def walk_nodes(e, start=None):
+    acc = []
+    e.walk(lambda n, a: ("take", a.append(n) or a), acc, start=start)
+    return acc
+
+
+def test_get_value_timestamp_children(pair):
+    e, o = pair
+    for path in all_paths(o):
+        en, on = e.get(path), o.get(path)
+        assert en is not None and on is not None
+        assert en.value == on.get_value()
+        assert en.timestamp == on.timestamp
+        assert en.path == on.path
+        assert [c.path for c in en.children()] == \
+            [c.path for c in __import__(
+                'crdt_graph_tpu.core.node', fromlist=['x']
+            ).iter_visible(on)]
+    assert e.get((424242,)) is None and o.get((424242,)) is None
+
+
+def test_next_prev_parent_match_oracle(pair):
+    e, o = pair
+    for path in all_paths(o):
+        en, on = e.get(path), o.get(path)
+        for name in ("next", "prev"):
+            ge = getattr(e, name)(en)
+            go = getattr(o, name)(on)
+            assert (ge is None) == (go is None), (name, path)
+            if ge is not None:
+                assert ge.path == go.path, (name, path)
+        pe, po = e.parent(en), o.parent(on)
+        if po is None or po.kind == "root":
+            assert pe is not None and pe.is_root
+        else:
+            assert pe.path == po.path
+
+
+def test_resumable_walk_matches_oracle(pair):
+    e, o = pair
+    paths = all_paths(o)
+    rng = random.Random(5)
+    for path in rng.sample(paths, min(8, len(paths))):
+        got = [n.path for n in walk_nodes(e, start=e.get(path))]
+        want = []
+        o.walk(lambda n, a: ("take", a.append(n.path) or a), want,
+               start=o.get(path))
+        assert got == want, path
+
+
+def test_walk_early_exit(pair):
+    e, o = pair
+    stops = []
+    out = e.walk(lambda n, a: ("done", a + 1) if a >= 2 else ("take", a + 1),
+                 0)
+    assert out == 3 or out <= 2  # stopped at the third visible node
+
+
+def test_root_and_id(pair):
+    e, o = pair
+    assert e.root().is_root and e.root().value is None
+    assert e.id == o.id == 42
+    kids = e.root().children()
+    assert [k.path for k in kids] == [p for p in all_paths(o)
+                                      if len(p) == 1]
+
+
+def test_tombstone_node_view():
+    e = engine.init(1)
+    e.add("a").add("b")
+    first = e.visible_paths()[0]
+    e.delete(first)
+    n = e.get(first)
+    assert n is not None and n.is_deleted and n.value is None
