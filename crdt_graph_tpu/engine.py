@@ -99,6 +99,7 @@ class TableNode:
 
     def children(self) -> List["TableNode"]:
         """Visible children in document order."""
+        self._check()
         t = self._tree.table()
         mask = np.asarray(t.visible) & \
             (np.asarray(t.parent) == self._slot) & \
@@ -108,17 +109,22 @@ class TableNode:
         return [TableNode(self._tree, int(s)) for s in slots]
 
     def __eq__(self, other) -> bool:
+        # generation participates: a stale view must not compare equal to a
+        # live view that happens to reuse its slot number
         return isinstance(other, TableNode) and other._slot == self._slot \
-            and other._tree is self._tree
+            and other._tree is self._tree and other._gen == self._gen
 
     def __hash__(self) -> int:
-        return hash((id(self._tree), self._slot))
+        return hash((id(self._tree), self._slot, self._gen))
 
     def __repr__(self) -> str:
         if self.is_root:
             return "TableNode(root)"
-        return (f"TableNode(ts={self.timestamp}, path={self.path}, "
-                f"value={self.value!r})")
+        try:
+            return (f"TableNode(ts={self.timestamp}, path={self.path}, "
+                    f"value={self.value!r})")
+        except StaleNodeView:
+            return f"TableNode(stale, slot={self._slot})"
 
 
 class TpuTree:
@@ -134,6 +140,9 @@ class TpuTree:
         self._max_depth = max_depth
         self._table: Optional[NodeTable] = None
         self._packed: Optional[PackedOps] = None
+        # bumped whenever the materialised table is replaced or discarded;
+        # TableNode captures it at construction so stale views fail loudly
+        self._generation = 0
 
     # -- identity / clocks (parity: CRDTree.elm:130-139, 337-350) ---------
 
@@ -183,6 +192,7 @@ class TpuTree:
     def _invalidate(self) -> None:
         self._table = None
         self._packed = None
+        self._generation += 1
 
     # -- remote application (parity: CRDTree.elm:235-295) -----------------
 
@@ -239,6 +249,7 @@ class TpuTree:
             if all_applied:
                 # candidate packing == new log packing: reuse the view
                 self._table, self._packed = table, p
+                self._generation += 1
             else:
                 # absorbed ops sit in the candidate arrays but not in the
                 # log, so value_ref indices would skew — re-materialise from
@@ -405,6 +416,7 @@ class TpuTree:
 
     def parent(self, node: TableNode) -> Optional[TableNode]:
         """Parent of a node; the root for depth-1 nodes."""
+        node._check()
         if node.is_root:
             return None
         p = int(np.asarray(self.table().parent)[node._slot])
@@ -412,6 +424,7 @@ class TpuTree:
 
     def _siblings(self, node: TableNode) -> np.ndarray:
         """Existing same-branch siblings (incl. tombstones), doc order."""
+        node._check()
         t = self.table()
         parent = np.asarray(t.parent)
         mask = np.asarray(t.exists) & (parent == parent[node._slot])
@@ -464,6 +477,8 @@ class TpuTree:
         is exclusive: the walk resumes after ``start``'s subtree and covers
         the remainder of its sibling list (with full descents), matching
         the oracle."""
+        if start is not None:
+            start._check()
         t = self.table()
         vis_order = np.asarray(t.visible_order)[:int(t.num_visible)]
         if start is None or start.is_root:

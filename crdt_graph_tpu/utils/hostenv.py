@@ -1,0 +1,56 @@
+"""Host-process environment hygiene for CPU-only runs.
+
+The execution environment force-registers a TPU PJRT plugin at interpreter
+start (sitecustomize); if that backend is allowed to initialise in a process
+that should stay on CPU (tests, mesh dry-runs), it can block forever on the
+device-tunnel grant when a sibling process holds the chip.  These helpers
+are the single source of truth for pinning a process to a virtual CPU mesh;
+tests/conftest.py and __graft_entry__ both use them.
+
+This module must stay importable BEFORE jax backend initialisation: it does
+not import jax at module level.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def scrub_tpu_env(n_devices: int = 8) -> None:
+    """Set env so the NEXT backend init lands on an n-device CPU host.
+
+    Safe to call before ``import jax``; callers must still follow up with
+    ``jax.config.update("jax_platforms", "cpu")`` after importing jax,
+    because plugin registration may rewrite the platform list.
+    """
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"{_COUNT_FLAG}={n_devices}"
+    if want not in flags.split():
+        flags = re.sub(_COUNT_FLAG + r"=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Pin this process to an n-device virtual CPU mesh, rebuilding the
+    backend if one already initialised with too few devices."""
+    scrub_tpu_env(n_devices)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices("cpu")) < n_devices:
+        # a backend already initialised with too few devices.  XLA_FLAGS is
+        # parsed once per process by the C++ layer, so re-setting it is
+        # useless here — use the jax-level device-count config and rebuild
+        # the client
+        from jax.extend.backend import clear_backends
+        clear_backends()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        have = len(jax.devices("cpu"))
+        if have < n_devices:
+            raise RuntimeError(
+                f"could not obtain {n_devices} CPU devices (have {have}); "
+                "jax_num_cpu_devices rebuild failed")

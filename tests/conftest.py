@@ -9,16 +9,20 @@ The environment force-registers a TPU PJRT plugin at interpreter start
 also rewrite the platform list.  Tests must never touch the TPU tunnel —
 a concurrently running bench would deadlock on the device grant — so we both
 scrub the env and override the jax config explicitly before any backend
-initialises.
+initialises.  The scrub logic lives in crdt_graph_tpu.utils.hostenv (shared
+with __graft_entry__); it is loaded here by file path so nothing else of the
+package imports before the env is clean.
 """
+import importlib.util
 import os
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_spec = importlib.util.spec_from_file_location(
+    "_hostenv",
+    os.path.join(os.path.dirname(__file__), "..", "crdt_graph_tpu",
+                 "utils", "hostenv.py"))
+_hostenv = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_hostenv)
+_hostenv.scrub_tpu_env(8)
 
 import jax  # noqa: E402
 

@@ -106,3 +106,37 @@ def test_tombstone_node_view():
     e.delete(first)
     n = e.get(first)
     assert n is not None and n.is_deleted and n.value is None
+
+
+def test_stale_views_fail_loudly_everywhere():
+    """Any edit invalidates outstanding TableNodes: every access path —
+    accessors, children, and the tree-side traversal methods that take a
+    node — must raise StaleNodeView rather than silently resolve the old
+    slot against the re-sorted table."""
+    e = engine.init(1)
+    e.add("a").add("b").add("c")
+    n = e.get(e.visible_paths()[1])
+    e.add("d")  # re-materialises; slot indices reassigned
+    for access in (lambda: n.value, lambda: n.path, lambda: n.is_deleted,
+                   lambda: n.children(), lambda: e.parent(n),
+                   lambda: e.next(n), lambda: e.prev(n),
+                   lambda: e.walk(lambda x, a: ("take", a), None, start=n)):
+        with pytest.raises(engine.StaleNodeView):
+            access()
+    # re-fetching yields a live view
+    assert e.get(e.visible_paths()[1]).value == "b"
+
+
+def test_stale_view_identity_and_repr():
+    """A stale view never masquerades as a live one: unequal, distinct as a
+    dict key, and its repr reports staleness instead of raising."""
+    e = engine.init(1)
+    e.add("a").add("b")
+    n = e.get(e.visible_paths()[0])
+    live_repr = repr(n)
+    assert "stale" not in live_repr
+    e.add("c")
+    m = e.get(e.visible_paths()[0])  # may reuse n's slot number
+    assert n != m
+    assert len({n, m}) == 2
+    assert "stale" in repr(n)
