@@ -4,7 +4,7 @@
 converged tree by the batched semilattice join, on whatever accelerator JAX
 finds (the driver runs this on one real TPU chip).  Prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "device": ...}
 
 ``vs_baseline`` is measured against the north-star target itself (1M ops in
 100 ms ⇒ 10M ops/s, BASELINE.json `north_star`) since the reference
@@ -16,35 +16,132 @@ previous add, chain heads anchored at the branch sentinel), so the merge
 must interleave 64 chains of ~15.6k ops each under the RGA rule.
 Correctness of this shape is pinned by the oracle-parity suites in tests/;
 the full 5-config sweep lives in ``python -m crdt_graph_tpu.bench``.
+
+Robustness (round-1 failure was an unretried backend-init error): the
+parent process never initialises JAX.  It launches the measurement as a
+child process so that a hung TPU-tunnel grant or a transient
+``UNAVAILABLE`` backend error can be retried from a clean slate (JAX caches
+failed backend state in-process), with per-attempt timeouts and backoff.
+If the TPU never comes up, the final attempt runs pinned to CPU so the
+driver still records an honest (clearly device-tagged) number instead of
+nothing.  Progress streams to stderr per phase so a late failure keeps the
+partial evidence.
 """
 import json
+import os
+import subprocess
 import sys
-
-import jax
-
-jax.config.update("jax_enable_x64", True)
-
-from crdt_graph_tpu.bench.runner import time_merge            # noqa: E402
-from crdt_graph_tpu.bench.workloads import chain_workload     # noqa: E402
+import time
 
 N_REPLICAS = 64
 N_OPS = 1_000_000
 TARGET_OPS_PER_S = 1e7  # north star: 1M ops < 100 ms
 
+TPU_ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "2"))
+# per-attempt budget: workload gen + first compile + 5 repeats fit in
+# ~2 min on a healthy chip; the rest is headroom for a slow tunnel grant
+TPU_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "600"))
+CPU_TIMEOUT_S = 900     # measured full CPU run ≈ 90 s
+BACKOFF_S = (15, 45)
 
-def main() -> None:
+
+def _warn_siblings() -> None:
+    """Best-effort: list other processes that might hold the TPU tunnel
+    (the conftest.py deadlock hazard applies to the bench too)."""
+    me = os.getpid()
+    suspects = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace")
+            except OSError:
+                continue
+            if "python" in cmd and any(
+                    k in cmd for k in ("bench", "pytest", "graft_entry",
+                                       "crdt_graph_tpu")):
+                suspects.append(f"  pid {pid}: {cmd[:120]}")
+    except OSError:
+        return
+    if suspects:
+        print("bench: WARNING sibling processes may hold the TPU:\n"
+              + "\n".join(suspects), file=sys.stderr, flush=True)
+
+
+def _child() -> None:
+    """The actual measurement (runs in its own process)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone is not enough: the axon sitecustomize can re-register
+        # the TPU plugin (see crdt_graph_tpu/utils/hostenv.py)
+        jax.config.update("jax_platforms", "cpu")
+
+    from crdt_graph_tpu.bench.runner import time_merge
+    from crdt_graph_tpu.bench.workloads import chain_workload
+
+    t0 = time.perf_counter()
     ops = chain_workload(N_REPLICAS, N_OPS)
-    stats = time_merge(ops, repeats=5)
+    print(f"bench: workload generated in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr, flush=True)
+    dev = jax.devices()[0]
+    print(f"bench: device {dev.device_kind} ({dev.platform})",
+          file=sys.stderr, flush=True)
+    stats = time_merge(ops, repeats=5, progress=True)
     assert stats["num_visible"] == stats["n_ops"], "merge dropped ops"
-    print(f"device={jax.devices()[0].device_kind} {stats}", file=sys.stderr)
+    print(f"bench: stats {stats}", file=sys.stderr, flush=True)
     ops_per_s = stats["ops_per_sec"]
     print(json.dumps({
         "metric": "crdt_merge_throughput_64rep_1Mops",
         "value": ops_per_s,
         "unit": "ops/s",
         "vs_baseline": round(ops_per_s / TARGET_OPS_PER_S, 3),
-    }))
+        "device": dev.device_kind,
+        "p50_ms": stats["p50_ms"],
+    }), flush=True)
+
+
+def _run_child(env: dict, timeout_s: int) -> int:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, timeout=timeout_s)
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"bench: attempt timed out after {timeout_s}s",
+              file=sys.stderr, flush=True)
+        return -1
+
+
+def main() -> None:
+    _warn_siblings()
+    env = dict(os.environ)
+    for attempt in range(TPU_ATTEMPTS):
+        print(f"bench: attempt {attempt + 1}/{TPU_ATTEMPTS} "
+              "(driver-selected backend)", file=sys.stderr, flush=True)
+        rc = _run_child(env, TPU_TIMEOUT_S)
+        if rc == 0:
+            return
+        if attempt < TPU_ATTEMPTS - 1:
+            pause = BACKOFF_S[min(attempt, len(BACKOFF_S) - 1)]
+            print(f"bench: rc={rc}; backing off {pause}s before retry",
+                  file=sys.stderr, flush=True)
+            time.sleep(pause)
+    print("bench: TPU attempts exhausted; falling back to CPU for an "
+          "honest (device-tagged) number", file=sys.stderr, flush=True)
+    cpu_env = dict(os.environ)
+    cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    rc = _run_child(cpu_env, CPU_TIMEOUT_S)
+    sys.exit(rc)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child()
+    else:
+        main()

@@ -27,19 +27,31 @@ def _as_arrays(workload) -> Dict[str, np.ndarray]:
     return packed_mod.pack(workload).arrays()
 
 
-def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5) -> dict:
-    """Compile, warm up, and time the jitted merge; returns timing stats."""
+def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
+               progress: bool = False) -> dict:
+    """Compile, warm up, and time the jitted merge; returns timing stats.
+
+    With ``progress=True``, each phase logs to stderr as it completes so a
+    late failure (timeout, backend loss) keeps the partial evidence.
+    """
+    def _log(msg: str) -> None:
+        if progress:
+            print(f"bench: {msg}", file=sys.stderr, flush=True)
+
     dev_ops = jax.device_put(ops)
+    _log("arrays on device")
     t0 = time.perf_counter()
     table = merge.materialize(dev_ops)
     jax.block_until_ready(table.ts)
     compile_s = time.perf_counter() - t0
+    _log(f"compiled + warm run in {compile_s:.1f}s")
     times = []
-    for _ in range(repeats):
+    for i in range(repeats):
         t0 = time.perf_counter()
         table = merge.materialize(dev_ops)
         jax.block_until_ready(table.ts)
         times.append(time.perf_counter() - t0)
+        _log(f"repeat {i + 1}/{repeats}: {times[-1] * 1e3:.1f} ms")
     p50 = sorted(times)[len(times) // 2]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
     return {

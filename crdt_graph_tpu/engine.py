@@ -1,24 +1,33 @@
 """The TPU replica engine: an array-backed CRDTree.
 
 ``TpuTree`` keeps the replica state the semilattice way: the state IS the
-operation set, and the tree is a materialised view produced by one batched
-kernel call (ops/merge.py).  Remote merge — the path BASELINE.json targets —
-is append + re-materialise, O(n log n) work with O(log n) parallel depth,
-instead of the reference's sequential per-op fold (CRDTree.elm:224-232,
-408-418).
+operation set, and the tree is a materialised view.  TWO materialisation
+paths share that state, split by delta size:
+
+- **Batched kernel** (ops/merge.py) for large deltas — anti-entropy
+  catch-up, bulk merges, the path BASELINE.json targets: O(n log n) work at
+  O(log n) parallel depth instead of the reference's sequential per-op fold
+  (CRDTree.elm:224-232, 408-418).
+- **Host mirror** (host_tree.py) for small deltas and ALL interactive
+  reads: the reference's own O(depth·log b + siblings) per-op application
+  (Internal/Node.elm:51-104) on mutable slot arrays, so a 1-op remote
+  delta on an n-op document costs O(delta), not a full re-merge.  After a
+  kernel merge the mirror is rebuilt from the NodeTable in one vectorised
+  pass; host applies in turn mark the device view stale.
 
 API parity: method names and semantics mirror the oracle ``CRDTree``
 (core/tree.py) — local edits stamp ``replica_id * 2**32 + counter``
 timestamps and move the cursor, remote ``apply`` does not move the cursor,
 ``operations_since`` serves pull-based anti-entropy from the vector clock,
 idempotent redelivery is absorbed, and failing remote batches raise without
-mutating state (batch atomicity falls out of materialise-then-commit).
-Unlike the persistent oracle, ``TpuTree`` is a MUTABLE container (it's the
-server-side engine; snapshot with ``checkpoint``/``restore``).  The full
-node-traversal combinator API lives on the oracle; ``to_oracle()`` converts.
+mutating state (host path: sequential apply + undo journal; kernel path:
+materialise-then-commit).  Unlike the persistent oracle, ``TpuTree`` is a
+MUTABLE container (it's the server-side engine; snapshot with
+``checkpoint``/``restore``).
 
-Materialisation is lazy: edits mark the view dirty, reads re-materialise at
-most once per batch of edits.
+View lifetimes: mirror slots are append-only, so ``TableNode`` views stay
+valid across host-path edits; a kernel merge compacts slots and bumps the
+generation, so views crossing it fail loudly (StaleNodeView).
 """
 from __future__ import annotations
 
@@ -32,28 +41,33 @@ from .core import operation as op_mod
 from .core import timestamp as ts_mod
 from .core.errors import InvalidPathError, NotFound, OperationFailedError
 from .core.operation import Add, Batch, Delete, Operation
+from .host_tree import NIL, HostTree
 from .ops import merge as merge_mod
 from .ops import view as view_mod
 from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
 
+# deltas at or under this many leaves apply host-side in O(delta); larger
+# ones re-materialise through the batched kernel
+DELTA_THRESHOLD = 256
+
 
 class StaleNodeView(RuntimeError):
-    """A TableNode outlived the table it points into.
+    """A TableNode outlived the state it points into.
 
-    Unlike the oracle's persistent nodes, engine views index a mutable
-    table whose slots are reassigned on every merge; using a view across
-    an edit would silently read a DIFFERENT node, so it fails loudly
-    instead.  Re-fetch with ``tree.get(node.path)``."""
+    Mirror slots are append-only, so views survive host-path edits; a
+    kernel merge compacts and reassigns slots, and using a view across one
+    would silently read a DIFFERENT node, so it fails loudly instead.
+    Re-fetch with ``tree.get(node.path)``."""
 
 
 class TableNode:
-    """Read-only node view over the materialised table — the engine-side
+    """Read-only node view over the host mirror — the engine-side
     counterpart of the oracle ``Node`` facade (CRDTree/Node.elm): value,
     timestamp, path accessors and visible-children traversal, resolved
-    directly from the array table without building a pointer tree.
+    from the mirror's slot arrays without any device round-trip.
 
-    Views are tied to one materialisation: any subsequent edit/merge
-    invalidates them (see :class:`StaleNodeView`)."""
+    Views are tied to one slot assignment: a kernel merge (large batch
+    apply) invalidates them (see :class:`StaleNodeView`)."""
 
     __slots__ = ("_tree", "_slot", "_gen")
 
@@ -65,21 +79,21 @@ class TableNode:
     def _check(self) -> None:
         if self._gen != self._tree._generation:
             raise StaleNodeView(
-                "node view predates the last edit/merge; re-fetch it with "
-                "tree.get(path)")
+                "node view predates the last kernel merge; re-fetch it "
+                "with tree.get(path)")
 
-    def _col(self, name: str):
+    def _mirror(self) -> HostTree:
         self._check()
-        return np.asarray(getattr(self._tree.table(), name))
+        return self._tree._ensure_mirror()
 
     @property
     def timestamp(self) -> int:
-        return int(self._col("ts")[self._slot]) if not self.is_root else 0
+        m = self._mirror()
+        return 0 if self.is_root else int(m.ts[self._slot])
 
     @property
     def path(self) -> Tuple[int, ...]:
-        d = int(self._col("depth")[self._slot])
-        return tuple(int(x) for x in self._col("paths")[self._slot, :d])
+        return self._mirror().path_of(self._slot)
 
     @property
     def is_root(self) -> bool:
@@ -87,26 +101,28 @@ class TableNode:
 
     @property
     def is_deleted(self) -> bool:
-        return bool(self._col("tombstone")[self._slot])
+        """Tombstoned directly OR gone with a deleted ancestor branch —
+        either way the node left the document (a held view can observe
+        this in place, since host edits don't invalidate views)."""
+        m = self._mirror()
+        return bool(m.tomb[self._slot]) or m.is_dead(self._slot)
 
     @property
     def value(self) -> Any:
         """Value unless deleted or root (CRDTree/Node.elm:198-202)."""
+        m = self._mirror()
         if self.is_root or self.is_deleted:
             return None
-        ref = int(self._col("value_ref")[self._slot])
-        return self._tree._ensure_packed().values[ref]
+        return m.values[int(m.value_ref[self._slot])]
 
     def children(self) -> List["TableNode"]:
-        """Visible children in document order."""
-        self._check()
-        t = self._tree.table()
-        mask = np.asarray(t.visible) & \
-            (np.asarray(t.parent) == self._slot) & \
-            (np.arange(np.asarray(t.parent).shape[0]) != self._slot)
-        slots = np.nonzero(mask)[0]
-        slots = slots[np.argsort(np.asarray(t.doc_index)[slots])]
-        return [TableNode(self._tree, int(s)) for s in slots]
+        """Visible children in document order; a deleted node's children
+        left the tree with it."""
+        m = self._mirror()
+        if not self.is_root and self.is_deleted:
+            return []
+        return [TableNode(self._tree, s)
+                for s in m.iter_visible_children(self._slot)]
 
     def __eq__(self, other) -> bool:
         # generation participates: a stale view must not compare equal to a
@@ -140,7 +156,9 @@ class TpuTree:
         self._max_depth = max_depth
         self._table: Optional[NodeTable] = None
         self._packed: Optional[PackedOps] = None
-        # bumped whenever the materialised table is replaced or discarded;
+        self._mirror: Optional[HostTree] = None
+        self._batch_depth = 0
+        # bumped whenever mirror slots are reassigned (kernel merges);
         # TableNode captures it at construction so stale views fail loudly
         self._generation = 0
 
@@ -181,7 +199,8 @@ class TpuTree:
     # -- the materialised view -------------------------------------------
 
     def table(self) -> NodeTable:
-        """The converged node table (host numpy); re-materialised lazily."""
+        """The converged node table (host numpy); re-materialised lazily
+        through the batched kernel from the op log."""
         if self._table is None:
             self._packed = packed_mod.pack(self._log,
                                            max_depth=self._max_depth)
@@ -189,9 +208,37 @@ class TpuTree:
                 merge_mod.materialize(self._packed.arrays()))
         return self._table
 
-    def _invalidate(self) -> None:
+    def _ensure_mirror(self) -> HostTree:
+        """The host mirror, built lazily: from an existing table when one
+        is materialised, through the kernel for big logs, by sequential
+        replay for small ones."""
+        if self._mirror is None:
+            if self._table is None and len(self._log) <= DELTA_THRESHOLD:
+                m = HostTree(self._max_depth)
+                for op in self._log:
+                    if isinstance(op, Add):
+                        m.apply_add(op.ts, tuple(op.path), op.value)
+                    else:
+                        m.apply_delete(tuple(op.path))
+                m.journal.clear()
+                self._mirror = m
+            else:
+                self._mirror = HostTree.from_table(
+                    self.table(), self._ensure_packed().values,
+                    self._max_depth)
+        return self._mirror
+
+    def _stale_device(self) -> None:
+        """Host-path edit: device view no longer matches the log; mirror
+        (and outstanding views) stay valid."""
         self._table = None
         self._packed = None
+
+    def _invalidate(self) -> None:
+        """Full invalidation: slots will be reassigned — views go stale."""
+        self._table = None
+        self._packed = None
+        self._mirror = None
         self._generation += 1
 
     # -- remote application (parity: CRDTree.elm:235-295) -----------------
@@ -199,16 +246,64 @@ class TpuTree:
     def apply(self, operation: Operation) -> "TpuTree":
         """Apply a remote operation/batch atomically; cursor unmoved.
 
-        The whole candidate log is materialised once; per-op statuses decide
-        what enters the log (duplicates and edits under deleted branches are
-        absorbed).  Any NotFound/InvalidPath in the batch raises and leaves
-        the replica untouched — reference batch atomicity
+        Small deltas (≤ DELTA_THRESHOLD leaves) apply sequentially on the
+        host mirror in O(delta) — the reference's own per-op cost
+        (Internal/Node.elm:51-104) — rolled back via the undo journal on
+        failure.  Large deltas materialise the whole candidate log through
+        the batched kernel once; per-op statuses decide what enters the
+        log.  Either way duplicates and edits under deleted branches are
+        absorbed, and any NotFound/InvalidPath in the batch raises and
+        leaves the replica untouched — reference batch atomicity
         (tests/CRDTreeTest.elm:482-498).
         """
         leaves = list(op_mod.iter_leaves(operation))
         if not leaves:
             self._last_operation = Batch(())
             return self
+        if len(leaves) <= DELTA_THRESHOLD:
+            applied = self._apply_host(leaves)
+        else:
+            applied = self._apply_kernel(leaves)
+        self._last_operation = (
+            applied[0] if len(leaves) == 1 and applied
+            else Batch(tuple(applied)))
+        # the clock advances once per Add carrying our own replica id —
+        # including absorbed duplicates, and including Adds arriving through
+        # remote apply (reference: incrementTimestamp runs on the Ok path,
+        # CRDTree.elm:275-282, 318-319, 337-343)
+        own_adds = sum(1 for op in leaves
+                       if isinstance(op, Add)
+                       and ts_mod.replica_id(op.ts) == self._replica)
+        self._timestamp += own_adds
+        return self
+
+    def _apply_host(self, leaves: List[Operation]) -> List[Operation]:
+        """Sequential host-path apply; first failure rolls everything back
+        and raises (the oracle stops there too, CRDTree.elm:224-232)."""
+        m = self._ensure_mirror()
+        sp = m.savepoint()
+        applied: List[Operation] = []
+        for op in leaves:
+            if isinstance(op, Add):
+                st = m.apply_add(op.ts, tuple(op.path), op.value)
+            else:
+                st = m.apply_delete(tuple(op.path))
+            if st == NOT_FOUND:
+                m.rollback(sp)
+                raise OperationFailedError(op)
+            if st == INVALID_PATH:
+                m.rollback(sp)
+                raise InvalidPathError(f"invalid path in {op!r}")
+            if st == APPLIED:
+                applied.append(op)
+        self._record(applied)
+        if applied:
+            self._stale_device()
+        if self._batch_depth == 0:
+            m.journal.clear()
+        return applied
+
+    def _apply_kernel(self, leaves: List[Operation]) -> List[Operation]:
         p = packed_mod.concat(self._ensure_packed(),
                               packed_mod.pack(leaves,
                                               max_depth=self._max_depth))
@@ -225,30 +320,24 @@ class TpuTree:
             raise InvalidPathError(f"invalid path in {leaves[k]!r}")
         applied = [op for op, s in zip(leaves, st) if s == APPLIED]
         self._commit(applied, len(leaves) == len(applied), p, table)
-        self._last_operation = (
-            applied[0] if len(leaves) == 1 and applied
-            else Batch(tuple(applied)))
-        # the clock advances once per Add carrying our own replica id —
-        # including absorbed duplicates, and including Adds arriving through
-        # remote apply (reference: incrementTimestamp runs on the Ok path,
-        # CRDTree.elm:275-282, 318-319, 337-343)
-        own_adds = sum(1 for op in leaves
-                       if isinstance(op, Add)
-                       and ts_mod.replica_id(op.ts) == self._replica)
-        self._timestamp += own_adds
-        return self
+        return applied
 
-    def _commit(self, applied: List[Operation], all_applied: bool,
-                p: PackedOps, table: NodeTable) -> None:
+    def _record(self, applied: List[Operation]) -> None:
         for op in applied:
             ts = op_mod.op_timestamp(op)
             if ts is not None:
                 self._replicas[ts_mod.replica_id(ts)] = ts
         self._log.extend(applied)
+
+    def _commit(self, applied: List[Operation], all_applied: bool,
+                p: PackedOps, table: NodeTable) -> None:
+        self._record(applied)
         if applied:
             if all_applied:
-                # candidate packing == new log packing: reuse the view
+                # candidate packing == new log packing: reuse the view;
+                # mirror slots are reassigned — outstanding views go stale
                 self._table, self._packed = table, p
+                self._mirror = None
                 self._generation += 1
             else:
                 # absorbed ops sit in the candidate arrays but not in the
@@ -283,21 +372,40 @@ class TpuTree:
     def batch(self, funcs: Iterable[Callable[["TpuTree"], "TpuTree"]]
               ) -> "TpuTree":
         """Atomic local batch; accumulated last_operation like the oracle."""
-        saved = (list(self._log), self._timestamp, self._cursor,
+        # the log is append-only inside a batch, so snapshot by length —
+        # copying it would make every 1-op local edit O(log)
+        log_len0 = len(self._log)
+        saved = (self._timestamp, self._cursor,
                  dict(self._replicas), self._last_operation)
+        m0 = self._ensure_mirror()
+        sp = m0.savepoint()
         # a func that edits nothing must contribute nothing — the oracle
         # resets the accumulator before folding (core/tree.py batch)
         self._last_operation = Batch(())
         acc: List[Operation] = []
+        self._batch_depth += 1
         try:
             for f in funcs:
                 f(self)
                 acc.extend(op_mod.to_list(self._last_operation))
         except Exception:
-            (self._log, self._timestamp, self._cursor,
+            del self._log[log_len0:]
+            (self._timestamp, self._cursor,
              self._replicas, self._last_operation) = saved
-            self._invalidate()
+            if self._mirror is m0 and len(m0.journal) >= sp:
+                # every edit since the savepoint was host-path: undo them
+                # in place; outstanding views stay valid
+                m0.rollback(sp)
+                self._stale_device()
+            else:
+                # a kernel merge replaced the mirror mid-batch — rebuild
+                # from the restored log
+                self._invalidate()
             raise
+        finally:
+            self._batch_depth -= 1
+        if self._batch_depth == 0 and self._mirror is not None:
+            self._mirror.journal.clear()
         self._last_operation = Batch(tuple(acc))
         return self
 
@@ -320,47 +428,25 @@ class TpuTree:
         whose next-VISIBLE sibling is the target — i.e. the nearest visible
         predecessor, or the first tombstone of a leading tombstone run, or
         the target's own path when it heads the chain."""
-        table = self.table()
-        idx = self._slot_at(path)
-        doc = np.asarray(table.doc_index)
-        exists = np.asarray(table.exists)
-        depth = np.asarray(table.depth)
-        parent = np.asarray(table.parent)
-        visible = np.asarray(table.visible)
-        paths = np.asarray(table.paths)
-        tombstone = np.asarray(table.tombstone)
-        dead = np.asarray(table.dead)
-
-        def node_path(s: int) -> Tuple[int, ...]:
-            return tuple(int(x) for x in paths[s, :depth[s]])
-
-        if idx is not None and tombstone[idx] and not dead[idx]:
+        m = self._ensure_mirror()
+        idx = m.get_slot(tuple(path))
+        if idx is not None and m.tomb[idx]:
             # tombstoned target: the reference probe (next-visible == target)
             # never matches, cursor defaults to the target path
             return path
-        if idx is None or dead[idx]:
-            # missing or dead target (oracle get() sees None either way): the
-            # reference falls back to the root branch and matches the first
-            # chain member with NO visible successor
-            mask = exists & (depth == 1)
-            sibs = np.nonzero(mask)[0]
-            sibs = sibs[np.argsort(doc[sibs])]
-            vis_idx = np.nonzero(visible[sibs])[0]
-            if vis_idx.size == 0:
-                return node_path(int(sibs[0])) if sibs.size else path
-            return node_path(int(sibs[int(vis_idx[-1])]))
-        # visible target: nearest visible predecessor in its branch, else the
-        # first tombstone of the leading run, else the target's own path
-        mask = exists & (parent == parent[idx]) & (depth == depth[idx])
-        sibs = np.nonzero(mask)[0]
-        sibs = sibs[np.argsort(doc[sibs])]
-        k = int(np.nonzero(sibs == idx)[0][0])
-        if k == 0:
-            return path
-        before = sibs[:k]
-        vis_before = before[visible[before]]
-        best = int(vis_before[-1]) if vis_before.size else int(before[0])
-        return node_path(best)
+        if idx is None:
+            # missing or dead target (oracle get() sees None either way):
+            # the reference falls back to the root branch and matches the
+            # first chain member with NO visible successor
+            chain = list(m.iter_siblings(0))
+            vis = [s for s in chain if not m.tomb[s]]
+            if vis:
+                return m.path_of(vis[-1])
+            return m.path_of(chain[0]) if chain else path
+        # visible target: nearest visible predecessor in its branch, else
+        # the first tombstone of the leading run, else the target's own path
+        p = m.prev_for(idx)
+        return m.path_of(p) if p is not None else path
 
     # -- anti-entropy (parity: CRDTree.elm:390-418) -----------------------
 
@@ -375,23 +461,18 @@ class TpuTree:
     def _slot_at(self, path: Tuple[int, ...]) -> Optional[int]:
         """Slot of the node at ``path`` — tombstones included, discarded
         descendants of deleted branches excluded, matching the oracle's
-        ``get`` (a tombstone's children leave the tree, core/tree.py:195)."""
-        table = self.table()
-        d = len(path)
-        if d == 0 or d > self._max_depth:
-            return None
-        hit = np.nonzero(
-            np.asarray(table.exists) & ~np.asarray(table.dead) &
-            (np.asarray(table.depth) == d) &
-            np.all(np.asarray(table.paths)[:, :d] ==
-                   np.asarray(path, dtype=np.int64), axis=1))[0]
-        return int(hit[0]) if hit.size else None
+        ``get`` (a tombstone's children leave the tree, core/tree.py:195).
+        O(depth) via the mirror's timestamp index."""
+        return self._ensure_mirror().get_slot(tuple(path))
 
     def get_value(self, path: Sequence[int]) -> Any:
         """Value at path; None if missing, deleted, or under a deleted
         branch."""
-        return view_mod.get_value(self.table(), self._ensure_packed().values,
-                                  path)
+        m = self._ensure_mirror()
+        s = m.get_slot(tuple(path))
+        if s is None or s == 0 or m.tomb[s]:
+            return None
+        return m.values[int(m.value_ref[s])]
 
     def _ensure_packed(self) -> PackedOps:
         if self._packed is None:
@@ -401,8 +482,8 @@ class TpuTree:
 
     def visible_values(self) -> List[Any]:
         """Visible values in document order — the render path."""
-        table = self.table()
-        return view_mod.visible_values(table, self._ensure_packed().values)
+        m = self._ensure_mirror()
+        return [m.values[int(m.value_ref[s])] for s in m.iter_visible()]
 
     # -- node views and traversal (parity: CRDTree.elm:423-625) -----------
 
@@ -419,25 +500,20 @@ class TpuTree:
         node._check()
         if node.is_root:
             return None
-        p = int(np.asarray(self.table().parent)[node._slot])
-        return TableNode(self, p)
-
-    def _siblings(self, node: TableNode) -> np.ndarray:
-        """Existing same-branch siblings (incl. tombstones), doc order."""
-        node._check()
-        t = self.table()
-        parent = np.asarray(t.parent)
-        mask = np.asarray(t.exists) & (parent == parent[node._slot])
-        slots = np.nonzero(mask)[0]
-        return slots[np.argsort(np.asarray(t.doc_index)[slots])]
+        return TableNode(self, int(self._ensure_mirror().parent[node._slot]))
 
     def next(self, node: TableNode) -> Optional[TableNode]:
-        """Next visible sibling (CRDTree.elm:563-568)."""
-        sibs = self._siblings(node)
-        visible = np.asarray(self.table().visible)
-        after = sibs[np.nonzero(sibs == node._slot)[0][0] + 1:]
-        vis = after[visible[after]]
-        return TableNode(self, int(vis[0])) if vis.size else None
+        """Next visible sibling (CRDTree.elm:563-568); O(tombstone run).
+        A node in a deleted branch has no visible siblings — its whole
+        chain left the tree."""
+        node._check()
+        m = self._ensure_mirror()
+        if node.is_root or m.is_dead(node._slot):
+            return None
+        s = m.nxt[node._slot]
+        while s != NIL and m.tomb[s]:
+            s = m.nxt[s]
+        return TableNode(self, int(s)) if s != NIL else None
 
     def prev(self, node: TableNode) -> Optional[TableNode]:
         """Previous sibling, reference-faithfully (CRDTree.elm:573-577):
@@ -445,67 +521,38 @@ class TpuTree:
         the nearest visible predecessor when one exists, otherwise the
         FIRST tombstone of a leading tombstone run (the reference's raw
         ``find`` does not skip tombstone candidates)."""
-        sibs = self._siblings(node)
-        visible = np.asarray(self.table().visible)
-        before = sibs[:int(np.nonzero(sibs == node._slot)[0][0])]
-        if not before.size:
+        node._check()
+        m = self._ensure_mirror()
+        if node.is_root or m.is_dead(node._slot):
             return None
-        vis = before[visible[before]]
-        if vis.size:
-            return TableNode(self, int(vis[-1]))
-        return TableNode(self, int(before[0]))
-
-    def _is_descendant(self, slot: int, ancestor: int) -> bool:
-        if ancestor == 0:
-            return slot != 0
-        parent = np.asarray(self.table().parent)
-        depth = np.asarray(self.table().depth)
-        cur = slot
-        for _ in range(int(depth[slot])):
-            cur = int(parent[cur])
-            if cur == ancestor:
-                return True
-            if cur == 0:
-                return False
-        return False
+        p = m.prev_for(node._slot)
+        return TableNode(self, p) if p is not None else None
 
     def walk(self, func: Callable[[TableNode, Any], Tuple[str, Any]],
              acc: Any, start: Optional[TableNode] = None) -> Any:
         """Resumable depth-first fold over visible nodes in document order
-        (CRDTree.elm:583-625) — pre-order IS document order, so the walk is
-        a linear scan of the visible ordering with early exit.  ``start``
-        is exclusive: the walk resumes after ``start``'s subtree and covers
-        the remainder of its sibling list (with full descents), matching
-        the oracle."""
+        (CRDTree.elm:583-625), straight off the mirror's sibling lists —
+        O(1) per visited node, with early exit.  ``start`` is exclusive:
+        the walk resumes after ``start``'s subtree and covers the remainder
+        of its sibling list (with full descents), matching the oracle."""
         if start is not None:
             start._check()
-        t = self.table()
-        vis_order = np.asarray(t.visible_order)[:int(t.num_visible)]
+        m = self._ensure_mirror()
         if start is None or start.is_root:
-            for s in vis_order:
-                step, acc = func(TableNode(self, int(s)), acc)
-                if step == "done":
-                    return acc
-            return acc
-        doc_index = np.asarray(t.doc_index)
-        parent = np.asarray(t.parent)
-        p = int(parent[start._slot])
-        start_pos = int(doc_index[start._slot])
-        for s in vis_order:
-            s = int(s)
-            if doc_index[s] <= start_pos:
-                continue
-            if self._is_descendant(s, start._slot):
-                continue                      # still inside start's subtree
-            if not (p == 0 or self._is_descendant(s, p)):
-                break                         # left parent(start)'s subtree
+            it = m.iter_visible()
+        elif m.is_dead(start._slot):
+            return acc          # start's whole chain left the tree
+        else:
+            it = m.iter_visible_after(start._slot)
+        for s in it:
             step, acc = func(TableNode(self, s), acc)
             if step == "done":
                 return acc
         return acc
 
     def visible_paths(self) -> List[tuple]:
-        return view_mod.visible_paths(self.table())
+        m = self._ensure_mirror()
+        return [m.path_of(s) for s in m.iter_visible()]
 
     def move_cursor_up(self) -> "TpuTree":
         if len(self._cursor) > 1:
@@ -520,7 +567,7 @@ class TpuTree:
         return self
 
     def __len__(self) -> int:
-        return int(self.table().num_visible)
+        return self._ensure_mirror().count_visible()
 
     def __repr__(self) -> str:
         return (f"TpuTree(replica={self._replica}, ops={len(self._log)}, "

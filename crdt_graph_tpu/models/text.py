@@ -39,6 +39,11 @@ class TextBuffer:
         else:
             raise ValueError(f"unknown engine {engine!r}")
         self._engine = engine
+        # visible-path cache, maintained incrementally across LOCAL edits
+        # (splice at the edit index) and invalidated by remote merges —
+        # keeps per-edit cost O(op), independent of document length
+        self._pc: List[Tuple[int, ...]] = []
+        self._pc_valid = True
 
     # -- views ------------------------------------------------------------
 
@@ -46,18 +51,23 @@ class TextBuffer:
         return "".join(str(v) for v in self._visible_values())
 
     def __len__(self) -> int:
-        return len(self._visible_values())
+        return len(self._visible_paths())
 
     def _visible_values(self) -> List[str]:
         return self._t.visible_values()
 
     def _visible_paths(self) -> List[Tuple[int, ...]]:
-        if self._engine == "tpu":
-            return self._t.visible_paths()
-        paths: List[Tuple[int, ...]] = []
-        self._t.walk(lambda n, acc: ("take", acc.append(n.path) or acc),
-                     paths)
-        return paths
+        if not self._pc_valid:
+            if self._engine == "tpu":
+                self._pc = self._t.visible_paths()
+            else:
+                paths: List[Tuple[int, ...]] = []
+                self._t.walk(
+                    lambda n, acc: ("take", acc.append(n.path) or acc),
+                    paths)
+                self._pc = paths
+            self._pc_valid = True
+        return self._pc
 
     # -- local edits ------------------------------------------------------
 
@@ -77,7 +87,41 @@ class TextBuffer:
         for ch in chunk[1:]:
             funcs.append(lambda t, c=ch: t.add(c))
         self._t = self._t.batch(funcs)
-        return self._t.last_operation
+        delta = self._t.last_operation
+        if self._pc_valid:
+            from ..core.operation import Add
+            new_paths = [tuple(op.path[:-1]) + (op.ts,)
+                         for op in self._iter_leaves(delta)
+                         if isinstance(op, Add)]
+            # the RGA rule may have placed the chars further right than the
+            # requested index (a right-neighbour with a HIGHER timestamp
+            # pulls rank, Internal/Node.elm:93-104) — splice only when the
+            # engine confirms each char landed exactly after its intended
+            # predecessor, else fall back to a rebuild on next read
+            if (self._engine == "tpu"
+                    and self._placement_matches(index, new_paths)):
+                self._pc[index:index] = new_paths
+            else:
+                self._pc_valid = False
+        return delta
+
+    def _placement_matches(self, index: int,
+                           new_paths: List[Tuple[int, ...]]) -> bool:
+        """Did the chunk land contiguously at ``index``?  Checks each new
+        char's nearest visible predecessor in the mirror — O(chunk·depth)."""
+        m = self._t._ensure_mirror()
+        expected = self._pc[index - 1] if index > 0 else None
+        for p in new_paths:
+            slot = m.get_slot(p)
+            if slot is None:
+                return False
+            pred = m.prev_for(slot)
+            pred_path = (m.path_of(pred)
+                         if pred is not None and not m.tomb[pred] else None)
+            if pred_path != expected:
+                return False
+            expected = p
+        return True
 
     def delete(self, index: int, count: int = 1) -> Operation:
         """Delete ``count`` characters starting at ``index``; returns the
@@ -88,6 +132,7 @@ class TextBuffer:
         doomed = self._visible_paths()[index:index + count]
         self._t = self._t.batch(
             [lambda t, p=p: t.delete(p) for p in doomed])
+        del self._pc[index:index + count]
         return self._t.last_operation
 
     def _anchor_path(self, index: int) -> Sequence[int]:
@@ -105,9 +150,15 @@ class TextBuffer:
     def last_operation(self) -> Operation:
         return self._t.last_operation
 
+    @staticmethod
+    def _iter_leaves(op: Operation):
+        from ..core import operation as op_mod
+        return op_mod.iter_leaves(op)
+
     def apply(self, delta: Operation) -> "TextBuffer":
         """Merge a remote delta (cursor-stable, idempotent)."""
         self._t = self._t.apply(delta)
+        self._pc_valid = False          # remote edits land anywhere
         return self
 
     def operations_since(self, ts: int) -> Operation:

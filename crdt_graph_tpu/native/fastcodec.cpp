@@ -44,6 +44,20 @@ struct Parser {
   const char* p;
   const char* end;
   std::string err;
+  int value_depth = 0;  // recursion guard for value_py/skip_value
+
+  // Untrusted wire input must not be able to overflow the C stack with
+  // deep nesting (Python's json raises RecursionError; we fail the parse).
+  // 512 matches the operation() batch-nesting cap.
+  static constexpr int kMaxValueDepth = 512;
+
+  struct DepthGuard {
+    int& d;
+    bool ok;
+    explicit DepthGuard(int& depth)
+        : d(depth), ok(++depth <= kMaxValueDepth) {}
+    ~DepthGuard() { --d; }
+  };
 
   explicit Parser(const char* data, Py_ssize_t n)
       : begin(data), p(data), end(data + n) {}
@@ -216,6 +230,8 @@ struct Parser {
 
   // ---- generic values (for "val" payloads) -> Python objects ----
   PyObject* value_py() {
+    DepthGuard guard(value_depth);
+    if (!guard.ok) { fail("value nesting too deep"); return nullptr; }
     ws();
     if (p >= end) { fail("unexpected end"); return nullptr; }
     switch (*p) {
@@ -318,6 +334,8 @@ struct Parser {
 
   // Validate-and-skip a JSON value textually (no Python objects built).
   bool skip_value() {
+    DepthGuard guard(value_depth);
+    if (!guard.ok) return fail("value nesting too deep");
     ws();
     if (p >= end) return fail("unexpected end");
     switch (*p) {

@@ -51,15 +51,17 @@ bottleneck):
 
 - **One 64-bit sort, then dense int32 slots.**  Timestamps are sorted once
   as (hi, lo) int32 key pairs; every downstream comparison uses the dense
-  slot ids, whose order IS timestamp order.  No int64 feeds a sort, a
-  gather, or a pointer loop after step 1.
-- **Path validation by polynomial hashing.**  "Claimed prefix == parent's
-  materialised path" (what the reference's recursive descent checks,
-  Internal/Node.elm:138-163) compares D-element int64 rows; done literally
-  it gathers [M, D] rows twice.  Instead each op's claimed path is hashed
-  (elementwise, no gather) and compared against the parent's full-path
-  hash — one [M] gather.  Hashes are 64-bit polynomial; a false accept
-  needs a 2^-64 collision against a malformed concurrent path.
+  slot ids, whose order IS timestamp order.  No int64 feeds a sort or a
+  pointer loop after step 1.
+- **Exact path validation, one row gather per check.**  "Claimed prefix ==
+  parent's materialised path" (what the reference's recursive descent
+  checks, Internal/Node.elm:138-163) is one [M, D] gather of the parent's
+  materialised path row, compared elementwise under a depth mask against
+  the op's own claimed row (already op-indexed — no second gather); the
+  delete-target check is the same shape.  Exact equality — no hash, so no
+  collision surface for adversarial peers (a fixed-base polynomial hash
+  here would let a malicious op forge a colliding path).  Cost vs a 1-wide
+  hash compare is a D-wide gather (D ≤ 16), noise next to the sorts.
 - **Fixpoint loops exit early.**  Validity cascading, tombstone-subtree
   propagation and the nearest-smaller-ancestor chase are pointer-doubling
   loops that need their worst-case O(log N) trips only for adversarial
@@ -112,10 +114,6 @@ PAD = 4
 BIG = MAX_TS          # sorts-after-everything timestamp sentinel (python int:
                       # promotes against int64 arrays without x64-mode issues)
 IPOS = 2**31 - 1      # "no position" / +inf for int32 positions
-
-# 64-bit polynomial-hash base for path validation (odd ⇒ invertible mod 2^64)
-HASH_P = 0x9E3779B97F4A7C15
-
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -254,15 +252,8 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
         jnp.where(not_big, slot_of_sorted, NULL))
     op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(~run_start & not_big)
 
-    # ---- 2. Path hashes (elementwise — replaces [M, D] row gathers).
-    ppow = jnp.asarray(
-        [pow(HASH_P, i, 2**64) for i in range(D)], dtype=jnp.uint64)
-    terms = paths.astype(jnp.uint64) * ppow[None, :]
+    # ---- 2. Column index row, shared by the masked path compares below.
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
-    # claimed anchor path = first depth-1 elements; full path = all depth
-    h_claim_op = jnp.sum(
-        jnp.where(cols < depth[:, None] - 1, terms, 0), axis=1)
-    h_full_op = jnp.sum(jnp.where(cols < depth[:, None], terms, 0), axis=1)
 
     # ---- 3. Scatter canonical adds into the node table (slots 1..N).
     tgt = jnp.where(is_canon, slot_of_sorted, NULL)
@@ -278,18 +269,13 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
     node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
         paths[sorted_idx], mode="drop")
-    node_h_claim = scat(jnp.zeros(M, jnp.uint64), g(h_claim_op))
     is_node_slot = scat(jnp.zeros(M, bool), is_canon)
 
     # Full materialised path: claimed anchor path with the node's own ts in
-    # the last position (Internal/Node.elm:79-82); its hash extends the
-    # claimed hash by one term.
+    # the last position (Internal/Node.elm:79-82).
     col = jnp.clip(node_depth - 1, 0, D - 1)
     fp = node_claimed.at[slot_ids, col].set(
         jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]))
-    node_h_full = jnp.where(
-        node_depth > 0,
-        node_h_claim + node_ts.astype(jnp.uint64) * ppow[col], 0)
 
     # ---- 4. Timestamp → slot lookups, batched into ONE searchsorted over
     # the sorted add axis (queries: per-slot parent & anchor, per-op delete
@@ -313,11 +299,13 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
     node_anchor_is_sentinel = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
 
-    # ---- 5. Local validity per node slot: the claimed prefix must hash-
+    # ---- 5. Local validity per node slot: the claimed prefix must exactly
     # match the parent's materialised path (what "descending the path"
     # validates in the reference, Internal/Node.elm:138-163), the anchor
     # must be a sibling (same parent), depths must chain.
-    prefix_ok = node_h_claim == node_h_full[pslot]
+    prefix_ok = jnp.all(
+        jnp.where(cols < node_depth[:, None] - 1,
+                  node_claimed == fp[pslot], True), axis=1)
     depth_ok = (node_depth >= 1) & (node_depth <= D) & \
         (node_depth == node_depth[pslot] + 1)
     parent_ok = pfound & depth_ok & prefix_ok
@@ -342,9 +330,11 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
 
     # ---- 7. Deletes: tombstone valid targets (first delete per target wins
     # the log; the tree flag is an idempotent OR either way).  Target match
-    # checks the full path by hash.
+    # checks the full claimed path exactly against the target's
+    # materialised path.
     d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
-    d_path_ok = h_full_op == node_h_full[d_tslot]
+    d_path_ok = jnp.all(
+        jnp.where(cols < depth[:, None], paths == fp[d_tslot], True), axis=1)
     d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
         d_depth_ok & d_path_ok
     d_tgt = jnp.where(d_ok, d_tslot, NULL)

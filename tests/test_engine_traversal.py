@@ -108,15 +108,42 @@ def test_tombstone_node_view():
     assert n is not None and n.is_deleted and n.value is None
 
 
-def test_stale_views_fail_loudly_everywhere():
-    """Any edit invalidates outstanding TableNodes: every access path —
-    accessors, children, and the tree-side traversal methods that take a
-    node — must raise StaleNodeView rather than silently resolve the old
-    slot against the re-sorted table."""
+def test_views_survive_host_edits():
+    """Mirror slots are append-only: outstanding TableNodes stay valid —
+    and stay CORRECT — across small (host-path) edits."""
     e = engine.init(1)
     e.add("a").add("b").add("c")
     n = e.get(e.visible_paths()[1])
-    e.add("d")  # re-materialises; slot indices reassigned
+    e.add("d")  # host path: no slot reassignment
+    assert n.value == "b"
+    assert [c.path for c in n.children()] == []
+    assert e.next(n) is not None and e.prev(n) is not None
+    # a delete flips visibility in place; the view reflects it live
+    e.delete(n.path)
+    assert n.is_deleted and n.value is None
+
+
+def _big_batch(n0, count=engine.DELTA_THRESHOLD + 1):
+    """A >threshold remote batch (forces the kernel path)."""
+    rid = 9
+    ops = []
+    prev = 0
+    for i in range(1, count + 1):
+        ts = rid * 2**32 + n0 + i
+        ops.append(crdt.Add(ts, (prev,), f"r{i}"))
+        prev = ts
+    return crdt.Batch(tuple(ops))
+
+
+def test_stale_views_fail_loudly_after_kernel_merge():
+    """A kernel merge compacts/reassigns slots: every access path —
+    accessors, children, and the tree-side traversal methods that take a
+    node — must raise StaleNodeView rather than silently resolve the old
+    slot against the rebuilt mirror."""
+    e = engine.init(1)
+    e.add("a").add("b").add("c")
+    n = e.get(e.visible_paths()[1])
+    e.apply(_big_batch(0))
     for access in (lambda: n.value, lambda: n.path, lambda: n.is_deleted,
                    lambda: n.children(), lambda: e.parent(n),
                    lambda: e.next(n), lambda: e.prev(n),
@@ -124,7 +151,7 @@ def test_stale_views_fail_loudly_everywhere():
         with pytest.raises(engine.StaleNodeView):
             access()
     # re-fetching yields a live view
-    assert e.get(e.visible_paths()[1]).value == "b"
+    assert e.get(e.visible_paths()[0]).value is not None
 
 
 def test_stale_view_identity_and_repr():
@@ -135,7 +162,7 @@ def test_stale_view_identity_and_repr():
     n = e.get(e.visible_paths()[0])
     live_repr = repr(n)
     assert "stale" not in live_repr
-    e.add("c")
+    e.apply(_big_batch(100))  # kernel merge: slots reassigned
     m = e.get(e.visible_paths()[0])  # may reuse n's slot number
     assert n != m
     assert len({n, m}) == 2

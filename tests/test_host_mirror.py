@@ -1,0 +1,143 @@
+"""The engine's two apply paths — host mirror (small deltas) and batched
+kernel (large deltas) — must be indistinguishable: same tree, same log,
+same atomicity, same view semantics, all pinned against the oracle.
+"""
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu import engine
+from crdt_graph_tpu.core import operation as op_mod
+from crdt_graph_tpu.host_tree import HostTree
+
+from test_merge_kernel import _random_session
+
+
+def snapshot(e):
+    return (e.visible_values(), e.visible_paths(), e.log_length,
+            len(e), e.timestamp, e.cursor)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_host_vs_kernel_vs_oracle(seed):
+    """One big-batch apply (kernel), per-op applies (host), and the oracle
+    all converge to the same tree on a >threshold random session."""
+    merged, ops = _random_session(seed, n_replicas=4, steps=400)
+    assert len(ops) > engine.DELTA_THRESHOLD, "session too small to force"
+
+    big = engine.init(42)
+    big.apply(crdt.Batch(tuple(ops)))           # kernel path
+
+    small = engine.init(42)
+    for op in ops:
+        small.apply(op)                          # host path, op by op
+
+    oracle_vis = merged.visible_values()
+    assert big.visible_values() == oracle_vis
+    assert small.visible_values() == oracle_vis
+    assert big.visible_paths() == small.visible_paths()
+    assert big.log_length == small.log_length == len(ops)
+
+
+def test_threshold_boundary_equal():
+    """Batches of exactly DELTA_THRESHOLD and DELTA_THRESHOLD+1 leaves land
+    on different paths but must produce identical state."""
+    rid = 3
+    for count in (engine.DELTA_THRESHOLD, engine.DELTA_THRESHOLD + 1):
+        ops, prev = [], 0
+        for i in range(1, count + 1):
+            ts = rid * 2**32 + i
+            ops.append(crdt.Add(ts, (prev,), i))
+            prev = ts
+        e = engine.init(1)
+        e.apply(crdt.Batch(tuple(ops)))
+        assert e.visible_values() == list(range(1, count + 1))
+        assert e.log_length == count
+
+
+@pytest.mark.parametrize("big", [False, True])
+def test_failing_batch_leaves_replica_untouched(big):
+    """Atomicity on BOTH paths: a NotFound mid-batch raises and rolls back
+    everything (host: undo journal; kernel: materialise-then-commit)."""
+    e = engine.init(1)
+    e.add("a").add("b")
+    before = snapshot(e)
+    rid = 7
+    count = engine.DELTA_THRESHOLD + 1 if big else 10
+    ops, prev = [], 0
+    for i in range(1, count + 1):
+        ts = rid * 2**32 + i
+        ops.append(crdt.Add(ts, (prev,), i))
+        prev = ts
+    # poison an op mid-batch: anchored at a timestamp nobody has
+    ops[count // 2] = crdt.Add(rid * 2**32 + count + 5, (999999,), "x")
+    with pytest.raises(crdt.OperationFailedError):
+        e.apply(crdt.Batch(tuple(ops)))
+    assert snapshot(e) == before
+
+
+def test_interleaved_host_and_kernel_applies():
+    """Alternating small and large applies stays oracle-exact."""
+    merged, ops = _random_session(21, n_replicas=3, steps=200)
+    e = engine.init(42)
+    o = crdt.init(42)
+    i = 0
+    chunk_sizes = [1, 3, engine.DELTA_THRESHOLD + 1, 2, 50]
+    k = 0
+    while i < len(ops):
+        n = chunk_sizes[k % len(chunk_sizes)]
+        k += 1
+        chunk = crdt.Batch(tuple(ops[i:i + n]))
+        e.apply(chunk)
+        o = o.apply(chunk)
+        i += n
+    assert e.visible_values() == o.visible_values()
+    assert e.log_length == len(op_mod.to_list(o.operations_since(0)))
+
+
+def test_absorbed_duplicates_on_host_path():
+    """Redelivering a whole delta through the host path is absorbed: log
+    stable, no error, last_operation empty-ish (CRDTree.elm:318-319)."""
+    e = engine.init(1)
+    e.add("a").add("b")
+    delta = e.operations_since(0)
+    n0 = e.log_length
+    e.apply(delta)
+    assert e.log_length == n0
+    assert list(op_mod.to_list(e.last_operation)) == []
+
+
+def test_mirror_rebuild_after_kernel_matches_replay():
+    """HostTree.from_table (vectorised rebuild) must equal a sequential
+    replay of the same log — links, paths, visibility, everything."""
+    merged, ops = _random_session(31, n_replicas=4, steps=400)
+    e = engine.init(9)
+    e.apply(crdt.Batch(tuple(ops)))             # kernel path
+    rebuilt = e._ensure_mirror()                # from_table
+    replayed = HostTree(e._max_depth)
+    for op in ops:
+        if isinstance(op, crdt.Add):
+            replayed.apply_add(op.ts, tuple(op.path), op.value)
+        else:
+            replayed.apply_delete(tuple(op.path))
+    a = [(rebuilt.path_of(s), rebuilt.values[int(rebuilt.value_ref[s])])
+         for s in rebuilt.iter_visible()]
+    b = [(replayed.path_of(s), replayed.values[int(replayed.value_ref[s])])
+         for s in replayed.iter_visible()]
+    assert a == b
+
+
+def test_local_batch_rollback_on_host_path():
+    """A failing local batch() rolls the mirror back in place; outstanding
+    views stay valid (no slot reassignment happened)."""
+    e = engine.init(1)
+    e.add("a").add("b")
+    n = e.get(e.visible_paths()[0])
+    before = snapshot(e)
+
+    def boom(t):
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError):
+        e.batch([lambda t: t.add("c"), lambda t: t.add("d"), boom])
+    assert snapshot(e) == before
+    assert n.value == "a"                       # view survived the rollback

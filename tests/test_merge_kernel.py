@@ -260,3 +260,21 @@ def test_status_parity_random_sequential():
             want.append("already_applied")
     vis, t, p = kernel_visible(ops)
     assert view.statuses(t, p.num_ops) == want
+
+
+def test_forged_prefix_rejected_exactly():
+    """Path validation is EXACT row comparison, not a hash: an op whose
+    claimed prefix names the right parent timestamp in the wrong positions
+    (or any adversarially-crafted near-miss) must be invalid_path.  Guards
+    the removal of the old fixed-base polynomial hash, which a malicious
+    peer could collide (ADVICE r1)."""
+    ops = [crdt.Add(1, (0,), "a"),           # node at path (1,)
+           crdt.Add(2, (1, 0), "b"),         # nested: path (1, 2)
+           # forged: claims parent prefix (2,) but node 2's path is (1, 2)
+           Add(7, (2, 2), "x"),
+           # forged: right length, wrong element
+           Add(8, (9, 2, 0), "y")]
+    vis, t, p = kernel_visible(ops)
+    assert vis == ["a", "b"]
+    assert view.statuses(t, p.num_ops)[2:] == \
+        ["invalid_path", "invalid_path"]

@@ -169,3 +169,19 @@ def test_python_json_extensions_accepted():
     assert math.isnan(got.values[0])
     assert_same('{"op":"add","path":[0],"ts":1,"val":"\\ud800"}')
     assert_same('{"op":"add","path":[0],"ts":1,"val":"\\ud800\\udc00x"}')
+
+
+def test_deep_nesting_rejected_not_segfault():
+    """Untrusted wire input with pathological nesting must fail the parse
+    cleanly (Python's json raises RecursionError; the native parser raises
+    ValueError) — never overflow the C stack.  Guards both the value_py
+    payload path and the skip_value unknown-field path."""
+    deep = "[" * 100_000 + "]" * 100_000
+    for doc in ('{"op":"add","path":[0],"ts":1,"val":' + deep + "}",
+                '{"op":"add","path":[0],"ts":1,"val":1,"x":' + deep + "}"):
+        with pytest.raises(ValueError, match="nesting too deep"):
+            native.parse_pack(doc)
+    # sane nesting (well under the 512 cap) still parses
+    ok = ('{"op":"add","path":[0],"ts":1,"val":'
+          + "[" * 100 + "1" + "]" * 100 + "}")
+    assert native.parse_pack(ok).num_ops == 1

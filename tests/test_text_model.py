@@ -101,3 +101,49 @@ def test_engines_equivalent_random_session():
             for d in docs.values():
                 d.delete(i, c)
         assert docs["oracle"].text() == docs["tpu"].text()
+
+
+def test_low_id_editor_insert_between_high_ts_chars():
+    """Regression (r2 review): the path cache must not assume index
+    placement.  A LOWER-id editor inserting between higher-ts characters
+    gets skip-scanned right by the RGA rule; text() and index edits must
+    track the REAL order (and agree with the oracle)."""
+    from crdt_graph_tpu.models.text import TextBuffer
+
+    low = TextBuffer(1, engine="tpu")
+    low.insert(0, "a")
+    high = TextBuffer(9, engine="oracle")
+    high.sync_from(low)
+    high.insert(1, "b")                  # higher replica id ⇒ higher ts
+    low.sync_from(high)
+    assert low.text() == "ab"
+    low.insert(1, "c")                   # RGA sends 'c' past 'b'
+    oracle = TextBuffer(5, engine="oracle")
+    oracle.sync_from(low)
+    assert low.text() == oracle.text() == "abc"
+    # index edits keep operating on the displayed order
+    low.delete(1)                        # deletes 'b', the char at index 1
+    oracle.sync_from(low)
+    assert low.text() == oracle.text() == "ac"
+
+
+def test_children_and_views_in_deleted_branch():
+    """Regression (r2 review): node views held into a branch that then gets
+    deleted must report is_deleted, value None, no children, no siblings —
+    the subtree left the document."""
+    import crdt_graph_tpu as crdt
+    from crdt_graph_tpu import engine
+
+    e = engine.init(1)
+    e.add_branch("p").add("child")
+    parent_path = e.visible_paths()[0]
+    child_path = e.visible_paths()[1]
+    pn = e.get(parent_path)
+    cn = e.get(child_path)
+    e.delete(parent_path)
+    assert pn.is_deleted and pn.children() == []
+    assert cn.is_deleted and cn.value is None
+    assert e.next(cn) is None and e.prev(cn) is None
+    assert e.walk(lambda n, a: ("take", a + [n.path]), [], start=cn) == []
+    assert e.get(child_path) is None and e.get_value(child_path) is None
+    assert e.visible_values() == []
