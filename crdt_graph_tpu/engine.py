@@ -118,11 +118,103 @@ class TableNode:
     def children(self) -> List["TableNode"]:
         """Visible children in document order; a deleted node's children
         left the tree with it."""
+        return [TableNode(self._tree, s) for s in self._child_slots()]
+
+    def _child_slots(self):
         m = self._mirror()
         if not self.is_root and self.is_deleted:
-            return []
-        return [TableNode(self._tree, s)
-                for s in m.iter_visible_children(self._slot)]
+            return iter(())
+        return m.iter_visible_children(self._slot)
+
+    # -- traversal combinators over THIS node's children — the engine-side
+    # face of the oracle facade (CRDTree/Node.elm:96-181; oracle spec
+    # core/node.py:226-300), resolved from the mirror arrays, never via
+    # to_oracle() ----------------------------------------------------------
+
+    def foldl(self, func: Callable[["TableNode", Any], Any],
+              acc: Any) -> Any:
+        """Left fold over visible children (CRDTree/Node.elm:118-124)."""
+        for s in self._child_slots():
+            acc = func(TableNode(self._tree, s), acc)
+        return acc
+
+    def foldr(self, func: Callable[["TableNode", Any], Any],
+              acc: Any) -> Any:
+        """Right fold over visible children (CRDTree/Node.elm:127-133)."""
+        for s in reversed(list(self._child_slots())):
+            acc = func(TableNode(self._tree, s), acc)
+        return acc
+
+    def map(self, func: Callable[["TableNode"], Any]) -> List[Any]:
+        """``func`` over visible children (CRDTree/Node.elm:101-105)."""
+        return [func(TableNode(self._tree, s)) for s in self._child_slots()]
+
+    def filter_map(self, func: Callable[["TableNode"], Any]) -> List[Any]:
+        """Keep non-None results (CRDTree/Node.elm:108-115)."""
+        out = []
+        for s in self._child_slots():
+            v = func(TableNode(self._tree, s))
+            if v is not None:
+                out.append(v)
+        return out
+
+    def loop(self, func: Callable[["TableNode", Any], Tuple[str, Any]],
+             acc: Any) -> Any:
+        """Left fold with early exit: ``func`` returns ("take", acc) to
+        continue or ("done", acc) to stop (CRDTree/Node.elm:136-160)."""
+        for s in self._child_slots():
+            step, acc = func(TableNode(self._tree, s), acc)
+            if step == "done":
+                return acc
+        return acc
+
+    def find(self, pred: Callable[["TableNode"], bool]
+             ) -> Optional["TableNode"]:
+        """First CHAIN member matching ``pred`` — tombstones are candidates
+        too: the reference's findHelp follows raw next pointers without
+        skipping (Internal/Node.elm:166-183)."""
+        m = self._mirror()
+        if not self.is_root and self.is_deleted:
+            return None
+        for s in m.iter_siblings(self._slot):
+            n = TableNode(self._tree, s)
+            if pred(n):
+                return n
+        return None
+
+    def head(self) -> Optional["TableNode"]:
+        """First visible child (CRDTree/Node.elm:163-166)."""
+        for s in self._child_slots():
+            return TableNode(self._tree, s)
+        return None
+
+    def last(self) -> Optional["TableNode"]:
+        """Last visible child (CRDTree/Node.elm:169-172)."""
+        out = None
+        for s in self._child_slots():
+            out = s
+        return TableNode(self._tree, out) if out is not None else None
+
+    def descendant(self, path: Sequence[int]) -> Optional["TableNode"]:
+        """Node at ``path`` relative to this node, by child timestamps —
+        O(len(path)) via the mirror's timestamp index
+        (Internal/Node.elm:289-299; CRDTree/Node.elm:175-181).  Can land ON
+        a tombstone (they keep their position) but not descend through
+        one (their children left the tree)."""
+        if not path:
+            return None
+        m = self._mirror()
+        if not self.is_root and self.is_deleted:
+            return None
+        cur = self._slot
+        for i, ts in enumerate(path):
+            if i > 0 and m.tomb[cur]:
+                return None
+            s = m.ts2slot.get(int(ts))
+            if s is None or m.parent[s] != cur:
+                return None
+            cur = s
+        return TableNode(self._tree, int(cur))
 
     def __eq__(self, other) -> bool:
         # generation participates: a stale view must not compare equal to a
@@ -255,6 +347,13 @@ class TpuTree:
         absorbed, and any NotFound/InvalidPath in the batch raises and
         leaves the replica untouched — reference batch atomicity
         (tests/CRDTreeTest.elm:482-498).
+
+        Reorder contract (pinned by tests/test_reorder_semantics.py):
+        small batches have SEQUENCE semantics — reference-exact errors
+        under any permutation; large batches have SET semantics — bulk
+        anti-entropy absorbs any arrival order of a valid add set
+        (deletes stay order-sensitive: one placed before its target's
+        add fails the batch).
         """
         leaves = list(op_mod.iter_leaves(operation))
         if not leaves:

@@ -167,3 +167,81 @@ def test_stale_view_identity_and_repr():
     assert n != m
     assert len({n, m}) == 2
     assert "stale" in repr(n)
+
+
+# -- node-combinator facade vs oracle (CRDTree/Node.elm:96-181) -----------
+
+def _node_pairs(pair):
+    """(engine node, oracle node) for the root and every visible path."""
+    e, o = pair
+    out = [(e.root(), o.root)]
+    for path in all_paths(o):
+        out.append((e.get(path), o.get(path)))
+    return out
+
+
+def test_combinators_fold_map_head_last(pair):
+    e, o = pair
+    from crdt_graph_tpu.core import node as onode
+    for en, on in _node_pairs(pair):
+        assert en.map(lambda n: n.path) == \
+            onode.node_map(lambda n: n.path, on)
+        assert en.foldl(lambda n, a: a + [n.path], []) == \
+            onode.foldl(lambda n, a: a + [n.path], [], on)
+        assert en.foldr(lambda n, a: a + [n.path], []) == \
+            onode.foldr(lambda n, a: a + [n.path], [], on)
+        assert en.filter_map(
+            lambda n: n.path if n.timestamp % 2 else None) == \
+            onode.filter_map(
+                lambda n: n.path if n.timestamp % 2 else None, on)
+        eh, oh = en.head(), onode.head(on)
+        assert (eh is None) == (oh is None)
+        if eh is not None:
+            assert eh.path == oh.path
+        el, ol = en.last(), onode.last(on)
+        assert (el is None) == (ol is None)
+        if el is not None:
+            assert el.path == ol.path
+
+
+def test_combinators_loop_and_find(pair):
+    e, o = pair
+    from crdt_graph_tpu.core import node as onode
+    for en, on in _node_pairs(pair):
+        got = en.loop(
+            lambda n, a: ("done", a) if len(a) >= 2 else
+            ("take", a + [n.path]), [])
+        want = onode.loop(
+            lambda n, a: ("done", a) if len(a) >= 2 else
+            ("take", a + [n.path]), [], on)
+        assert got == want
+        # find: tombstones ARE candidates (raw chain scan)
+        ef = en.find(lambda n: n.timestamp % 3 == 0)
+        of = onode.find(lambda n: n.timestamp % 3 == 0, on)
+        assert (ef is None) == (of is None)
+        if ef is not None:
+            assert ef.path == of.path
+        ef = en.find(lambda n: n.is_deleted)
+        of = onode.find(lambda n: n.is_deleted(), on)
+        assert (ef is None) == (of is None)
+        if ef is not None:
+            assert ef.path == of.path
+
+
+def test_combinator_descendant(pair):
+    e, o = pair
+    from crdt_graph_tpu.core import node as onode
+    er = e.root()
+    for path in all_paths(o):
+        got = er.descendant(path)
+        want = onode.descendant(o.root, path)
+        assert (got is None) == (want is None), path
+        if got is not None:
+            assert got.path == want.path
+        # relative descent from each node's parent
+        if len(path) > 1:
+            en = e.get(path[:-1])
+            got2 = en.descendant(path[-1:])
+            assert got2 is not None and got2.path == path
+    assert er.descendant(()) is None
+    assert er.descendant((987654,)) is None

@@ -1,0 +1,113 @@
+"""Pin what `apply` guarantees under NON-causal batch orders (VERDICT r1
+weak-5 / next-9).
+
+Two deliberate regimes, split by DELTA_THRESHOLD:
+
+- **Host path (small deltas): SEQUENCE semantics, reference-exact.**  Ops
+  apply in batch order; an op whose anchor hasn't arrived yet fails the
+  whole batch exactly like the oracle/reference (CRDTree.elm:224-232), no
+  matter the permutation, and a failed batch never half-commits.
+- **Kernel path (large deltas): SET semantics.**  Bulk anti-entropy must
+  absorb any arrival order of a valid op set — that is the CRDT promise —
+  so the batched join resolves anchors against the whole set and order
+  inside the batch does not matter for adds.  (Deletes targeting an add
+  placed LATER in the batch still fail: ops/merge.py d_target_later.)
+
+The converged TREE is identical wherever both paths accept.
+"""
+import itertools
+
+import pytest
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu import engine
+
+R = 5 * 2**32
+
+
+def _nested_ops():
+    """5 causally-chained ops: branch, child, sibling, delete, grandchild."""
+    return (
+        crdt.Add(R + 1, (0,), "branch"),
+        crdt.Add(R + 2, (R + 1, 0), "child"),
+        crdt.Add(R + 3, (R + 1,), "sibling"),
+        crdt.Delete((R + 1, R + 2)),
+        crdt.Add(R + 4, (R + 3, 0), "grandchild"),
+    )
+
+
+def test_host_path_every_permutation_matches_oracle():
+    """All 120 permutations: the engine's small-batch apply raises exactly
+    when the oracle raises, with the same error type, and never commits a
+    half batch; accepted permutations converge identically."""
+    ops = _nested_ops()
+    outcomes = set()
+    for perm in itertools.permutations(ops):
+        e = engine.init(50)
+        o = crdt.init(50)
+        e_err = o_err = None
+        try:
+            e.apply(crdt.Batch(perm))
+        except crdt.CRDTError as ex:
+            e_err = type(ex)
+        try:
+            o = o.apply(crdt.Batch(perm))
+        except crdt.CRDTError as ex:
+            o_err = type(ex)
+        assert e_err is o_err, perm
+        if e_err is None:
+            assert e.visible_values() == o.visible_values(), perm
+            outcomes.add(tuple(e.visible_values()))
+        else:
+            # atomicity: nothing committed
+            assert e.log_length == 0 and len(e) == 0, perm
+    # every accepted order converged to the same document
+    assert outcomes == {("branch", "sibling", "grandchild")}
+
+
+def _chain(count, rid=6):
+    ops, prev = [], 0
+    for i in range(1, count + 1):
+        ts = rid * 2**32 + i
+        ops.append(crdt.Add(ts, (prev,), i))
+        prev = ts
+    return ops
+
+
+def test_kernel_path_accepts_any_order_of_a_valid_set():
+    """A >threshold batch delivered fully REVERSED (every anchor arrives
+    after its dependant) still converges: the batched join is a set
+    semilattice, not a fold."""
+    n = engine.DELTA_THRESHOLD + 10
+    ops = _chain(n)
+    e = engine.init(1)
+    e.apply(crdt.Batch(tuple(reversed(ops))))
+    assert e.visible_values() == list(range(1, n + 1))
+    assert e.log_length == n
+
+
+def test_host_path_rejects_non_causal_order_like_the_reference():
+    """The SAME reversed chain, small enough for the host path, fails like
+    the oracle does (anchor not yet present ⇒ NotFound, batch atomic)."""
+    ops = _chain(10)
+    e = engine.init(1)
+    with pytest.raises(crdt.OperationFailedError):
+        e.apply(crdt.Batch(tuple(reversed(ops))))
+    assert e.log_length == 0 and len(e) == 0
+    o = crdt.init(1)
+    with pytest.raises(crdt.OperationFailedError):
+        o.apply(crdt.Batch(tuple(reversed(ops))))
+
+
+def test_delete_before_its_add_fails_on_both_paths():
+    """d_target_later: a delete positioned before its target's add fails
+    the batch on the kernel path too — deletes are order-sensitive even
+    under set semantics (first-arrival tombstoning needs the node)."""
+    for count in (10, engine.DELTA_THRESHOLD + 10):
+        ops = _chain(count)
+        first_ts = 6 * 2**32 + 1
+        batch = [crdt.Delete((first_ts,))] + ops
+        e = engine.init(1)
+        with pytest.raises(crdt.OperationFailedError):
+            e.apply(crdt.Batch(tuple(batch)))
+        assert e.log_length == 0, count
