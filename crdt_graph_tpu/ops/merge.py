@@ -286,7 +286,12 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
         ts,                                             # delete target ts
         parent_ts,                                      # delete parent ts
     ])
-    qidx = jnp.searchsorted(sorted_ts, queries, side="left").astype(jnp.int32)
+    # method="sort" turns 4M binary searches (each ~20 serial gather steps —
+    # measured 1.67 s device time at 1M ops on v5e) into one sort-merge join
+    # (~0.09 s): rank the queries within one sorted concat.  Same exact
+    # semantics as the default scan method.
+    qidx = jnp.searchsorted(sorted_ts, queries, side="left",
+                            method="sort").astype(jnp.int32)
     qidx_c = jnp.minimum(qidx, N - 1)
     qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & (queries < BIG)
     qslot = jnp.where(queries == 0, ROOT,

@@ -1,10 +1,16 @@
 """Profiling and observability harness (SURVEY §5: the reference has none;
 the TPU framework owes timing + tracing around its merge path).
 
-- :func:`timed` — wall-clock statistics for any jitted callable, with
-  ``block_until_ready`` on the result (the only honest way to time XLA).
+- :func:`timed` — wall-clock statistics for any jitted callable, closed by
+  a forced device→host readback of the result.  ``block_until_ready`` is
+  NOT used: on this environment's experimental axon backend it returns
+  before execution finishes (VERDICT round 2, Weak-1); only a readback is
+  a trustworthy clock edge.  See bench.honest for the full harness
+  (fingerprint returns, bracketing audit).
 - :func:`trace` — context manager around ``jax.profiler`` emitting a
-  TensorBoard-loadable trace directory.
+  TensorBoard-loadable trace directory.  Works on CPU; on the axon TPU
+  backend ``stop_trace`` hangs (measured round 3) — prefer the
+  prefix-staged readback timing in scripts/probe_stages.py there.
 - :func:`table_stats` — structural summary of a merged NodeTable
   (fan-out, depth, tombstone load) for capacity planning and debugging.
 """
@@ -19,18 +25,30 @@ import numpy as np
 import jax
 
 
+def _force(x):
+    """Forced device→host readback — the honest timing barrier (the axon
+    backend's ``block_until_ready`` returns early; a readback cannot)."""
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), x)
+
+
 def timed(fn: Callable[..., Any], *args, repeats: int = 5,
           warmup: int = 1) -> Dict[str, float]:
-    """Run ``fn(*args)`` with warmup, return ms timing stats."""
+    """Run ``fn(*args)`` with warmup, return ms timing stats.
+
+    Each timed repeat ends with a full readback of the result; for large
+    results prefer returning a scalar fingerprint from ``fn`` (see
+    bench.honest.fingerprint) so transfer cost stays out of the number.
+    """
     out = None
     t0 = time.perf_counter()
     for _ in range(max(1, warmup)):
-        out = jax.block_until_ready(fn(*args))
+        out = _force(fn(*args))
     first = time.perf_counter() - t0
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(*args))
+        out = _force(fn(*args))
         times.append(time.perf_counter() - t0)
     times.sort()
     return {

@@ -1,0 +1,121 @@
+"""Probe 2: dispatch overhead, searchsorted methods, full-merge honest time."""
+import sys
+sys.path.insert(0, "/root/repo")
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+
+def force(x):
+    return np.asarray(jax.device_get(x))
+
+
+def honest(fn, *args, repeats=3, label=""):
+    t0 = time.perf_counter()
+    force(fn(*args))
+    warm = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        force(fn(*args))
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    print(f"{label:46s} warm {warm*1e3:9.1f} ms   p50 {p50*1e3:9.1f} ms",
+          flush=True)
+    return p50
+
+
+def checksum(*arrs):
+    s = jnp.int64(0)
+    for a in arrs:
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.int32)
+        s = s + jnp.sum(a.astype(jnp.int64) % 1000003)
+    return s
+
+
+def main():
+    N = 1_000_000
+    rng = np.random.default_rng(0)
+    ts64 = np.sort(rng.integers(1, 2**40, N, dtype=np.int64))
+    q64 = rng.integers(1, 2**40, 4 * N, dtype=np.int64)
+    d_ts = jax.device_put(ts64)
+    d_q = jax.device_put(q64)
+    tiny = jax.device_put(np.arange(8, dtype=np.int32))
+
+    @jax.jit
+    def trivial(x):
+        return jnp.sum(x + 1)
+
+    @jax.jit
+    def ss_scan(t, q):
+        return checksum(jnp.searchsorted(t, q, side="left"))
+
+    @jax.jit
+    def ss_sort(t, q):
+        return checksum(jnp.searchsorted(t, q, side="left", method="sort"))
+
+    @jax.jit
+    def ss_compare_all(t, q):
+        q1 = q[:4096]
+        return checksum(jnp.searchsorted(t, q1, side="left",
+                                         method="compare_all"))
+
+    # manual sort-merge join: defs (key, slot+1) + uses (key, 0),
+    # sort by (hi, lo, is_use); cummax of def payload fills uses
+    @jax.jit
+    def join_sort(t, q):
+        nk, nq = t.shape[0], q.shape[0]
+        keys = jnp.concatenate([t, q])
+        hi = (keys >> 32).astype(jnp.int32)
+        lo = ((keys & 0xFFFFFFFF) - 2**31).astype(jnp.int32)
+        tag = jnp.concatenate([jnp.zeros(nk, jnp.int8),
+                               jnp.ones(nq, jnp.int8)])
+        payload = jnp.concatenate([
+            jnp.arange(1, nk + 1, dtype=jnp.int32),
+            jnp.zeros(nq, jnp.int32)])
+        src = jnp.concatenate([jnp.full(nk, nk + nq, jnp.int32),
+                               jnp.arange(nq, dtype=jnp.int32)])
+        s_hi, s_lo, s_tag, s_pay, s_src = lax.sort(
+            (hi, lo, tag, payload, src), num_keys=3)
+        # def payload carries (hi,lo) implicitly: cummax fills forward, but
+        # must reset when key changes -> compare gathered def key
+        filled = lax.cummax(s_pay)
+        def_slot = filled - 1
+        ok = (filled > 0) & (s_tag == 1)
+        hit = ok & (t[jnp.clip(def_slot, 0, nk - 1)] == jnp.where(
+            s_tag == 1, s_hi.astype(jnp.int64) << 32
+            | (s_lo.astype(jnp.int64) + 2**31), -1))
+        ans = jnp.where(hit, def_slot, -1)
+        out = jnp.zeros(nq, jnp.int32).at[s_src].set(
+            jnp.where(s_tag == 1, ans, 0), mode="drop")
+        return checksum(out)
+
+    honest(trivial, tiny, repeats=5, label="trivial dispatch (8 elems)")
+    honest(ss_scan, d_ts, d_q[:N], label="searchsorted scan 1M q")
+    honest(ss_scan, d_ts, d_q, label="searchsorted scan 4M q")
+    honest(ss_sort, d_ts, d_q, label="searchsorted method=sort 4M q")
+    honest(join_sort, d_ts, d_q, label="manual sort-join 4M q")
+
+    from crdt_graph_tpu.bench.workloads import chain_workload
+    from crdt_graph_tpu.ops import merge
+
+    ops = chain_workload(64, 1_000_000)
+    dev_ops = jax.device_put(ops)
+
+    @jax.jit
+    def run(o):
+        t = merge._materialize(o)
+        return checksum(t.doc_index, t.num_visible, t.status)
+
+    honest(run, dev_ops, repeats=3, label="FULL merge 1M (64-chain)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,328 @@
+"""Prefix-staged honest timing of the merge kernel on the real chip.
+
+Times the kernel truncated after each stage; consecutive differences
+apportion device time per stage (each prefix is its own jit compile).
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench.workloads import chain_workload
+from crdt_graph_tpu.codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
+from crdt_graph_tpu.ops.merge import (_ceil_log2, _split_ts, _fix_and,
+                                      _fix_min, IPOS, BIG)
+
+
+def checksum(*arrs):
+    s = jnp.int64(0)
+    for a in arrs:
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.int32)
+        s = s + jnp.sum(a.astype(jnp.int64) % 1000003)
+    return s
+
+
+def staged(ops, stage):
+    """Body of _materialize, truncated after `stage`, returning a checksum
+    of that stage's live outputs."""
+    kind = ops["kind"]
+    ts = ops["ts"].astype(jnp.int64)
+    parent_ts = ops["parent_ts"].astype(jnp.int64)
+    anchor_ts = ops["anchor_ts"].astype(jnp.int64)
+    depth = ops["depth"].astype(jnp.int32)
+    paths = ops["paths"].astype(jnp.int64)
+    value_ref = ops["value_ref"].astype(jnp.int32)
+    pos = ops["pos"].astype(jnp.int32)
+
+    N = kind.shape[0]
+    D = paths.shape[1]
+    M = N + 2
+    ROOT = 0
+    NULL = M - 1
+    slot_ids = jnp.arange(M, dtype=jnp.int32)
+
+    is_add = kind == KIND_ADD
+    is_del = kind == KIND_DELETE
+
+    sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
+    ts_hi, ts_lo = _split_ts(sort_ts)
+    s_hi, s_lo, sorted_pos, sorted_idx = lax.sort(
+        (ts_hi, ts_lo, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
+    sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
+        (s_lo.astype(jnp.int64) + 2**31)
+    run_start = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+    not_big = s_hi < (BIG >> 32)
+    is_canon = run_start & not_big
+    canon_pos = lax.cummax(jnp.where(run_start,
+                                     jnp.arange(N, dtype=jnp.int32), 0))
+    slot_of_sorted = canon_pos + 1
+    op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
+        jnp.where(not_big, slot_of_sorted, NULL))
+    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(~run_start & not_big)
+    if stage == 1:
+        return checksum(op_slot, op_is_dup, sorted_ts)
+
+    cols = jnp.arange(D, dtype=jnp.int32)[None, :]
+    tgt = jnp.where(is_canon, slot_of_sorted, NULL)
+
+    def scat(init, vals, at=tgt):
+        return init.at[at].set(vals, mode="drop")
+
+    g = lambda a: a[sorted_idx]  # noqa: E731
+    node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
+        .at[NULL].set(BIG)
+    node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
+    node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
+    node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
+    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
+        paths[sorted_idx], mode="drop")
+    is_node_slot = scat(jnp.zeros(M, bool), is_canon)
+
+    col = jnp.clip(node_depth - 1, 0, D - 1)
+    fp = node_claimed.at[slot_ids, col].set(
+        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]))
+    if stage == 2:
+        return checksum(node_ts, node_depth, fp, is_node_slot)
+
+    queries = jnp.concatenate([
+        scat(jnp.zeros(M, jnp.int64), g(parent_ts)),
+        scat(jnp.zeros(M, jnp.int64), g(anchor_ts)),
+        ts,
+        parent_ts,
+    ])
+    qidx = jnp.searchsorted(sorted_ts, queries, side="left").astype(jnp.int32)
+    qidx_c = jnp.minimum(qidx, N - 1)
+    qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & (queries < BIG)
+    qslot = jnp.where(queries == 0, ROOT,
+                      jnp.where(qhit, qidx_c + 1, NULL))
+    qfound = (queries == 0) | qhit
+    pslot, aslot = qslot[:M], qslot[M:2 * M]
+    pfound, afound = qfound[:M], qfound[M:2 * M]
+    d_tslot, dp_slot = qslot[2 * M:2 * M + N], qslot[2 * M + N:]
+    d_tfound, dp_found = qfound[2 * M:2 * M + N], qfound[2 * M + N:]
+    pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
+    node_anchor_is_sentinel = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
+    if stage == 3:
+        return checksum(pslot, aslot, d_tslot, dp_slot)
+
+    prefix_ok = jnp.all(
+        jnp.where(cols < node_depth[:, None] - 1,
+                  node_claimed == fp[pslot], True), axis=1)
+    depth_ok = (node_depth >= 1) & (node_depth <= D) & \
+        (node_depth == node_depth[pslot] + 1)
+    parent_ok = pfound & depth_ok & prefix_ok
+    anchor_ok = node_anchor_is_sentinel | \
+        (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
+    local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
+    local_ok = local_ok.at[ROOT].set(True)
+    if stage == 4:
+        return checksum(local_ok, parent_ok)
+
+    order_parent = jnp.where(node_anchor_is_sentinel, pslot, aslot)
+    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
+    cascade_ok = _fix_and(local_ok | ~is_node_slot, order_parent,
+                          _ceil_log2(M) + 1)
+    valid = cascade_ok & is_node_slot
+    valid = valid.at[ROOT].set(True)
+    parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
+    if stage == 5:
+        return checksum(valid, parent_eff)
+
+    d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
+    d_path_ok = jnp.all(
+        jnp.where(cols < depth[:, None], paths == fp[d_tslot], True), axis=1)
+    d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
+        d_depth_ok & d_path_ok
+    d_tgt = jnp.where(d_ok, d_tslot, NULL)
+    deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
+    del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
+        .at[NULL].set(IPOS)
+    if stage == 6:
+        return checksum(deleted, del_pos)
+
+    anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
+    anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
+                       _ceil_log2(D) + 1)
+    dead = valid & (anc_del < IPOS)
+    if stage == 7:
+        return checksum(dead, anc_del)
+
+    in_forest = valid & is_node_slot
+    mptr0 = jnp.where(node_anchor_is_sentinel | ~in_forest, -1, aslot)
+    nsv_cap = _ceil_log2(M) + 2
+
+    def nsv_cond(state):
+        mptr, i = state
+        return (i < nsv_cap) & jnp.any((mptr >= 0) & (mptr > slot_ids))
+
+    def nsv_body(state):
+        mptr, i = state
+        m = jnp.where(mptr >= 0, mptr, NULL)
+        unresolved = (mptr >= 0) & (mptr > slot_ids)
+        return jnp.where(unresolved, mptr[m], mptr), i + 1
+
+    mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
+    star_parent = jnp.where(mptr >= 0, mptr, pslot)
+    star_sentinel = mptr < 0
+    if stage == 8:
+        return checksum(star_parent, star_sentinel)
+
+    order_parent = jnp.where(in_forest, star_parent, order_parent)
+    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
+    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
+    ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
+    neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
+    s_parent, _, _, s_slot = lax.sort(
+        (skey, ggrp, neg_slot, slot_ids), num_keys=3)
+    same_parent = s_parent[1:] == s_parent[:-1]
+    sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
+        jnp.where(same_parent, s_slot[1:], -1)).at[ROOT].set(-1)
+    s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
+    fc_tgt = jnp.where(s_start, s_parent, NULL)
+    first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
+        s_slot, mode="drop").at[NULL].set(-1)
+    if stage == 9:
+        return checksum(sib_next, first_child)
+
+    T = 2 * M
+    tok = jnp.arange(T, dtype=jnp.int32)
+    in_tour = in_forest.at[ROOT].set(True)
+    enter_succ = jnp.where(
+        ~in_tour, slot_ids,
+        jnp.where(first_child >= 0, first_child, M + slot_ids))
+    up = jnp.where(order_parent == slot_ids, M + slot_ids, M + order_parent)
+    exit_succ = jnp.where(
+        ~in_tour, M + slot_ids,
+        jnp.where(sib_next >= 0, sib_next, up))
+    succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
+
+    exists = valid & is_node_slot
+    tomb = deleted & exists
+    dead = dead & exists
+    visible = exists & ~tomb & ~dead
+
+    fwd = succ[:-1] == tok[1:]
+    bwd = succ[1:] == tok[:-1]
+    same_run = fwd | bwd
+    boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
+    rid = lax.cumsum(boundary.astype(jnp.int32)) - 1
+    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(tok)
+    run_e = jnp.zeros(T, jnp.int32).at[rid].max(tok)
+    run_fwd = succ[run_s] == run_s + 1
+    run_tail = jnp.where(run_fwd, run_e, run_s)
+    tail_succ = succ[run_tail]
+    run_terminal = tail_succ == run_tail
+    run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
+    if stage == 10:
+        return checksum(run_next, run_s, run_e)
+
+    zeros_m = jnp.zeros(M, jnp.int32)
+    w_doc = jnp.concatenate([exists.astype(jnp.int32), zeros_m])
+    w_vis = jnp.concatenate([visible.astype(jnp.int32), zeros_m])
+    cse_doc = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_doc)])
+    cse_vis = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_vis)])
+
+    def run_sum(cse):
+        return jnp.where(run_terminal, 0, cse[run_e + 1] - cse[run_s])
+
+    wy_cap = _ceil_log2(T) + 1
+
+    def wy_cond(state):
+        _, _, _, live, i = state
+        return live & (i < wy_cap)
+
+    def wy_body(state):
+        a, b, p, _, i = state
+        a2 = a + a[p]
+        b2 = b + b[p]
+        p2 = p[p]
+        return a2, b2, p2, jnp.any(p2 != p), i + 1
+
+    a_doc, a_vis, _, _, _ = lax.while_loop(
+        wy_cond, wy_body,
+        (run_sum(cse_doc), run_sum(cse_vis), run_next, jnp.array(True),
+         jnp.int32(0)))
+    if stage == 11:
+        return checksum(a_doc, a_vis)
+
+    def rank_of(a, cse):
+        within = jnp.where(run_fwd[rid],
+                           cse[tok] - cse[run_s[rid]],
+                           cse[run_e[rid] + 1] - cse[tok + 1])
+        e_tok = a[rid] - within
+        return e_tok[ROOT] - e_tok[:M]
+
+    doc_dense = rank_of(a_doc, cse_doc)
+    vis_dense = rank_of(a_vis, cse_vis)
+
+    doc_index = jnp.where(exists, doc_dense, IPOS)
+    order = jnp.full(M, NULL, jnp.int32).at[
+        jnp.where(exists, doc_dense, M)].set(slot_ids, mode="drop")
+    visible_order = jnp.full(M, NULL, jnp.int32).at[
+        jnp.where(visible, vis_dense, M)].set(slot_ids, mode="drop")
+    if stage == 12:
+        return checksum(doc_index, order, visible_order)
+
+    status = jnp.full(N, PAD := jnp.int8(4), jnp.int8)
+    a_slot = op_slot
+    a_valid = valid[a_slot]
+    a_parent_ok = parent_ok[a_slot]
+    a_absorbed = a_valid & (anc_del[a_slot] < pos)
+    a_sentinel = ts <= 0
+    a_status = jnp.where(
+        a_sentinel | (a_valid & (op_is_dup | a_absorbed)), 1,
+        jnp.where(a_valid, 0,
+                  jnp.where(a_parent_ok & valid[pslot[a_slot]], 2, 3)))
+    status = jnp.where(is_add, a_status.astype(jnp.int8), status)
+    d_parent_ok = (depth == 1) | ((depth >= 2) & dp_found & valid[dp_slot])
+    d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
+    d_repeat = d_ok & (del_pos[d_tslot] < pos)
+    d_target_later = d_ok & (node_pos[d_tslot] > pos)
+    d_sentinel = (ts == 0) & d_parent_ok
+    d_status = jnp.where(
+        d_sentinel | d_anc_absorbed | (d_repeat & ~d_target_later), 1,
+        jnp.where(d_ok & ~d_target_later, 0,
+                  jnp.where(d_target_later | d_parent_ok, 2, 3)))
+    status = jnp.where(is_del, d_status.astype(jnp.int8), status)
+    return checksum(doc_index, order, visible_order, status,
+                    jnp.sum(visible).astype(jnp.int32))
+
+
+def force(x):
+    return np.asarray(jax.device_get(x))
+
+
+def main():
+    ops = chain_workload(64, 1_000_000)
+    dev_ops = jax.device_put(ops)
+    stages = list(range(1, 14))
+    if len(sys.argv) > 1:
+        stages = [int(a) for a in sys.argv[1:]]
+    prev = 0.0
+    for st in stages:
+        fn = jax.jit(staged, static_argnums=1)
+        t0 = time.perf_counter()
+        force(fn(dev_ops, st))
+        warm = time.perf_counter() - t0
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            force(fn(dev_ops, st))
+            times.append(time.perf_counter() - t0)
+        p50 = min(times)
+        print(f"stage {st:2d}: p50 {p50*1e3:9.1f} ms   "
+              f"delta {(p50-prev)*1e3:9.1f} ms   (compile+warm {warm:.1f}s)",
+              flush=True)
+        prev = p50
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""TPU probe: honest stage-level timing of the merge kernel.
+
+Run ON THE REAL CHIP (no env scrub).  Every timed repeat forces a
+device-originated readback of a scalar that depends on the stage output,
+so the axon backend's lazy block_until_ready cannot fake it
+(VERDICT round 2, Weak-1).
+
+Usage: python scripts/probe_tpu.py [micro|full|prefix]
+"""
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+
+def force(x):
+    """Device-originated readback of a dependent scalar."""
+    return np.asarray(jax.device_get(x))
+
+
+def honest(fn, *args, repeats=3, label=""):
+    t0 = time.perf_counter()
+    force(fn(*args))
+    warm = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        force(fn(*args))
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    print(f"{label:42s} warm {warm*1e3:9.1f} ms   p50 {p50*1e3:9.1f} ms",
+          flush=True)
+    return p50
+
+
+def checksum(*arrs):
+    s = jnp.int64(0)
+    for a in arrs:
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.int32)
+        s = s + jnp.sum(a.astype(jnp.int64) % 1000003)
+    return s
+
+
+def micro():
+    N = 1_000_000
+    M = N + 2
+    D = 16
+    rng = np.random.default_rng(0)
+    ts64 = rng.integers(1, 2**40, N, dtype=np.int64)
+    hi = (ts64 >> 32).astype(np.int32)
+    lo = ((ts64 & 0xFFFFFFFF) - 2**31).astype(np.int32)
+    pos = np.arange(N, dtype=np.int32)
+    paths = rng.integers(0, 2**40, (M, D), dtype=np.int64)
+    paths32 = paths.astype(np.int32)
+    ptr = rng.integers(0, M, M, dtype=np.int32)
+    gidx = rng.integers(0, M, M, dtype=np.int32)
+
+    d_hi, d_lo, d_pos = map(jax.device_put, (hi, lo, pos))
+    d_paths = jax.device_put(paths)
+    d_paths32 = jax.device_put(paths32)
+    d_ptr = jax.device_put(ptr)
+    d_gidx = jax.device_put(gidx)
+    d_ts64 = jax.device_put(ts64)
+
+    @jax.jit
+    def sort3(h, l, p):
+        a, b, c, d = lax.sort((h, l, p, jnp.arange(N, dtype=jnp.int32)),
+                              num_keys=3)
+        return checksum(a, b, c, d)
+
+    @jax.jit
+    def sort1_32(h):
+        return checksum(lax.sort(h))
+
+    @jax.jit
+    def sort1_64(t):
+        return checksum(lax.sort(t))
+
+    @jax.jit
+    def gather_rows64(p, g):
+        return checksum(p[g])
+
+    @jax.jit
+    def gather_rows32(p, g):
+        return checksum(p[g])
+
+    @jax.jit
+    def gather_1col(p, g):
+        return checksum(p[g, 0])
+
+    @jax.jit
+    def searchsorted_q(t, q):
+        st = lax.sort(t)
+        return checksum(jnp.searchsorted(st, q, side="left"))
+
+    @jax.jit
+    def cumsum2m(x):
+        w = jnp.concatenate([x, x]).astype(jnp.int32)
+        return checksum(lax.cumsum(w))
+
+    @jax.jit
+    def wyllie20(p):
+        def body(state):
+            a, p, i = state
+            return a + a[p], p[p], i + 1
+
+        def cond(state):
+            return state[2] < 20
+
+        a, _, _ = lax.while_loop(
+            cond, body, (jnp.ones(M, jnp.int32), p, jnp.int32(0)))
+        return checksum(a)
+
+    @jax.jit
+    def doubling1(p):
+        def body(state):
+            a, p, i = state
+            return a + a[p], p[p], i + 1
+
+        def cond(state):
+            return state[2] < 1
+
+        a, _, _ = lax.while_loop(
+            cond, body, (jnp.ones(M, jnp.int32), p, jnp.int32(0)))
+        return checksum(a)
+
+    @jax.jit
+    def elementwise(h, l):
+        x = h.astype(jnp.int64) << 32 | (l.astype(jnp.int64) + 2**31)
+        return checksum(jnp.where(x > 5, x, 0) * 3)
+
+    honest(sort1_32, d_hi, label="sort 1M x i32 (1 key)")
+    honest(sort1_64, d_ts64, label="sort 1M x i64 (1 key)")
+    honest(sort3, d_hi, d_lo, d_pos, label="sort 1M x 4arr (3 i32 keys)")
+    honest(gather_rows64, d_paths, d_gidx, label="gather 1M rows [M,16] i64")
+    honest(gather_rows32, d_paths32, d_gidx, label="gather 1M rows [M,16] i32")
+    honest(gather_1col, d_paths, d_gidx, label="gather 1M single col i64")
+    honest(searchsorted_q, d_ts64, d_ts64, label="sort+searchsorted 1M q i64")
+    honest(cumsum2m, d_gidx, label="cumsum 2M i32")
+    honest(wyllie20, d_ptr, label="while_loop 20x gather-double 1M")
+    honest(doubling1, d_ptr, label="while_loop 1x gather-double 1M")
+    honest(elementwise, d_hi, d_lo, label="elementwise i64 pack+mul 1M")
+
+
+def full():
+    from crdt_graph_tpu.bench.workloads import chain_workload
+    from crdt_graph_tpu.ops import merge
+
+    ops = chain_workload(64, 1_000_000)
+    dev_ops = jax.device_put(ops)
+
+    @jax.jit
+    def run(o):
+        t = merge._materialize(o)
+        return checksum(t.doc_index, t.num_visible, t.status)
+
+    honest(run, dev_ops, repeats=3, label="FULL merge 1M (64-chain)")
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "micro"
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
+    if mode == "micro":
+        micro()
+    elif mode == "full":
+        full()

@@ -1,0 +1,63 @@
+"""Capture a jax.profiler device trace of the full merge on the TPU."""
+import sys
+sys.path.insert(0, "/root/repo")
+import glob
+import gzip
+import json
+import time
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench.workloads import chain_workload
+from crdt_graph_tpu.ops import merge
+
+
+def checksum(*arrs):
+    s = jnp.int64(0)
+    for a in arrs:
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.int32)
+        s = s + jnp.sum(a.astype(jnp.int64) % 1000003)
+    return s
+
+
+@jax.jit
+def run(o):
+    t = merge._materialize(o)
+    return checksum(t.doc_index, t.num_visible, t.status)
+
+
+ops = chain_workload(64, 1_000_000)
+dev_ops = jax.device_put(ops)
+np.asarray(jax.device_get(run(dev_ops)))  # compile + warm
+print("warm done", flush=True)
+
+logdir = "/tmp/jaxtrace"
+jax.profiler.start_trace(logdir)
+t0 = time.perf_counter()
+np.asarray(jax.device_get(run(dev_ops)))
+wall = time.perf_counter() - t0
+jax.profiler.stop_trace()
+print(f"traced run wall: {wall*1e3:.1f} ms", flush=True)
+
+files = glob.glob(logdir + "/**/*.trace.json.gz", recursive=True)
+print("trace files:", files, flush=True)
+for f in files:
+    with gzip.open(f, "rt") as fh:
+        data = json.load(fh)
+    events = data.get("traceEvents", [])
+    # aggregate complete events by name on TPU device tracks
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "X" and "dur" in e:
+            agg[e.get("name", "?")] += e["dur"]
+            cnt[e.get("name", "?")] += 1
+    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
+    for name, dur in rows:
+        print(f"{dur/1e3:10.1f} ms  x{cnt[name]:<5d} {name[:90]}")
