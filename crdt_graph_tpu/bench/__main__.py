@@ -2,6 +2,9 @@ import sys
 
 import jax
 
+from ..utils import compcache
+
+compcache.enable()
 jax.config.update("jax_enable_x64", True)
 
 from .runner import main  # noqa: E402

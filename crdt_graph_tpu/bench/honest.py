@@ -68,16 +68,18 @@ def time_with_readback(fn: Callable[..., Any], *args,
     a forced readback of the result (give ``fn`` a scalar/fingerprint
     return so the readback is 8 bytes, not the whole result).
 
-    Returns ``{"times_s": [...], "p50_ms": ..., "warm_ms": ...}``.
+    Returns ``{"times_s": [...], "p50_ms": ..., "warm_ms": ...,
+    "last_result": <forced host value of the final repeat>}`` — reuse
+    ``last_result`` instead of dispatching again for the result.
     """
     t0 = time.perf_counter()
-    force(fn(*args))
+    out = force(fn(*args))
     warm = time.perf_counter() - t0
     log(f"compile + warm run in {warm:.1f}s")
     times = []
     for i in range(repeats):
         t0 = time.perf_counter()
-        force(fn(*args))
+        out = force(fn(*args))
         times.append(time.perf_counter() - t0)
         log(f"repeat {i + 1}/{repeats}: {times[-1] * 1e3:.1f} ms")
     times_sorted = sorted(times)
@@ -86,6 +88,7 @@ def time_with_readback(fn: Callable[..., Any], *args,
         "p50_ms": round(times_sorted[len(times) // 2] * 1e3, 2),
         "min_ms": round(times_sorted[0] * 1e3, 2),
         "warm_ms": round(warm * 1e3, 1),
+        "last_result": out,
     }
 
 

@@ -164,6 +164,174 @@ def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
     }
 
 
+def chain_expected_ts(n_replicas: int = 64,
+                      n_ops: int = 1_000_000) -> np.ndarray:
+    """Closed-form converged visible sequence for :func:`chain_workload`.
+
+    The RGA converged order is the greedy max-timestamp linearisation of
+    the anchor forest (ops/merge.py docstring): all chain heads anchor at
+    the branch sentinel, so the highest-replica head is emitted first, and
+    once emitted its successor (same replica, next counter) outbids every
+    other head — each chain runs to completion before the next-highest
+    head.  Expected sequence: replicas in DESCENDING id order, each
+    replica's ops in counter order.  O(n) numpy; used by bench.py to
+    assert the order of the million-op merge, not just its count."""
+    per = n_ops // n_replicas
+    rids = np.arange(n_replicas, 0, -1, dtype=np.int64)
+    counters = np.arange(1, per + 1, dtype=np.int64)
+    return (rids[:, None] * OFFSET + counters[None, :]).ravel()
+
+
+# --- Adversarial kernel workloads (VERDICT round 2, task 3) -------------
+#
+# Each targets a documented worst case of the merge kernel; all are
+# causally valid op streams (anchors reference already-generated nodes).
+
+def descending_chains(n_replicas: int = 4096,
+                      n_ops: int = 1_000_000,
+                      max_depth: int = 16) -> Dict[str, np.ndarray]:
+    """Anchor chains with strictly DESCENDING timestamps — the worst case
+    of the nearest-smaller-ancestor chase (ops/merge.py step 9), which
+    exits in 0 trips on causal logs but needs its full O(log chain) trips
+    here: round j is one chain of ``n_replicas`` ops, replica ids walking
+    R, R-1, …, 1, each op anchored at the previous (larger-ts) one.
+
+    Timestamp order is the REVERSE of anchor order within every round, so
+    every node's T* parent chase walks to its round's head."""
+    per = n_ops // n_replicas          # rounds
+    n = per * n_replicas
+    rid = np.tile(np.arange(n_replicas, 0, -1, dtype=np.int64), per)
+    counter = np.repeat(np.arange(1, per + 1, dtype=np.int64), n_replicas)
+    ts = rid * OFFSET + counter
+    # within a round, op k anchors at op k-1; round heads anchor at 0
+    anchor = np.concatenate([[0], ts[:-1]])
+    anchor[np.arange(0, n, n_replicas)] = 0
+    paths = np.zeros((n, max_depth), dtype=np.int64)
+    paths[:, 0] = anchor
+    return {
+        "kind": np.zeros(n, dtype=np.int8),
+        "ts": ts,
+        "parent_ts": np.zeros(n, dtype=np.int64),
+        "anchor_ts": anchor,
+        "depth": np.ones(n, dtype=np.int32),
+        "paths": paths,
+        "value_ref": np.arange(n, dtype=np.int32),
+        "pos": np.arange(n, dtype=np.int32),
+    }
+
+
+def comb_pairs(n_ops: int = 1_000_000,
+               max_depth: int = 16) -> Dict[str, np.ndarray]:
+    """Tour-fragmentation worst case for the run-contracted list ranking
+    (ops/merge.py step 12): ``n_ops/2`` two-node combs — tooth ``a_k``
+    (replica 2) anchored at the sentinel, child ``b_k`` (replica 1)
+    anchored at ``a_k`` with a smaller timestamp.  The Euler tour
+    alternates between the two slot halves on every token, so maximal
+    ±1-stride runs have length ~1 and Wyllie runs at full 2M width for
+    its whole O(log T) trip budget."""
+    per = n_ops // 2
+    n = per * 2
+    k = np.arange(1, per + 1, dtype=np.int64)
+    a_ts = 2 * OFFSET + k
+    b_ts = 1 * OFFSET + k
+    ts = np.empty(n, dtype=np.int64)
+    ts[0::2] = a_ts
+    ts[1::2] = b_ts
+    anchor = np.empty(n, dtype=np.int64)
+    anchor[0::2] = 0
+    anchor[1::2] = a_ts
+    paths = np.zeros((n, max_depth), dtype=np.int64)
+    paths[:, 0] = anchor
+    return {
+        "kind": np.zeros(n, dtype=np.int8),
+        "ts": ts,
+        "parent_ts": np.zeros(n, dtype=np.int64),
+        "anchor_ts": anchor,
+        "depth": np.ones(n, dtype=np.int32),
+        "paths": paths,
+        "value_ref": np.arange(n, dtype=np.int32),
+        "pos": np.arange(n, dtype=np.int32),
+    }
+
+
+def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
+               max_depth: int = 16) -> Dict[str, np.ndarray]:
+    """Maximum-depth stress: replica 1 nests a branch skeleton to
+    ``max_depth - 1``, then every replica extends its own chain at the
+    deepest branch — every op carries a full 16-element path, exercising
+    the widest path-validation compares the kernel supports."""
+    skel_ts = np.array([OFFSET + c for c in range(1, max_depth)],
+                       dtype=np.int64)
+    n_skel = len(skel_ts)
+    branch = skel_ts                   # path of the deepest branch
+    per = (n_ops - n_skel) // n_replicas
+    n = n_skel + per * n_replicas
+
+    kind = np.zeros(n, dtype=np.int8)
+    ts = np.empty(n, dtype=np.int64)
+    parent_ts = np.zeros(n, dtype=np.int64)
+    anchor = np.zeros(n, dtype=np.int64)
+    depth = np.empty(n, dtype=np.int32)
+    paths = np.zeros((n, max_depth), dtype=np.int64)
+
+    # skeleton: each branch node anchored at its parent's sentinel
+    for i in range(n_skel):
+        ts[i] = skel_ts[i]
+        depth[i] = i + 1
+        paths[i, :i] = skel_ts[:i]
+        paths[i, i] = 0                # anchor = parent's sentinel
+        parent_ts[i] = skel_ts[i - 1] if i else 0
+        anchor[i] = 0
+
+    # chains at the deepest branch (replica 1's counters continue past the
+    # skeleton so its timestamps stay unique)
+    base = np.arange(n_skel, n)
+    rid = np.repeat(np.arange(1, n_replicas + 1, dtype=np.int64), per)
+    counter = np.tile(np.arange(1, per + 1, dtype=np.int64), n_replicas)
+    counter = counter + np.where(rid == 1, n_skel, 0)
+    cts = rid * OFFSET + counter
+    first = np.tile(np.concatenate([[True], np.zeros(per - 1, bool)]),
+                    n_replicas)
+    canchor = np.where(first, 0, np.concatenate([[0], cts[:-1]]))
+    ts[base] = cts
+    parent_ts[base] = branch[-1]
+    anchor[base] = canchor
+    depth[base] = max_depth
+    paths[base, :max_depth - 1] = branch
+    paths[base, max_depth - 1] = canchor
+    return {
+        "kind": kind,
+        "ts": ts,
+        "parent_ts": parent_ts,
+        "anchor_ts": anchor,
+        "depth": depth,
+        "paths": paths,
+        "value_ref": np.arange(n, dtype=np.int32),
+        "pos": np.arange(n, dtype=np.int32),
+    }
+
+
+def unpack_ops(arrs: Dict[str, np.ndarray]) -> List[Operation]:
+    """Packed arrays → op list (small sizes only; oracle cross-checks)."""
+    out: List[Operation] = []
+    for i in range(len(arrs["kind"])):
+        d = int(arrs["depth"][i])
+        path = tuple(int(x) for x in arrs["paths"][i, :d])
+        if int(arrs["kind"][i]) == 0:
+            out.append(Add(int(arrs["ts"][i]), path,
+                           int(arrs["value_ref"][i])))
+        else:
+            out.append(Delete(path))
+    return out
+
+
+ADVERSARIAL = {
+    "descending_chains_4096rep": descending_chains,
+    "comb_pairs_fragmented_tour": comb_pairs,
+    "deep_paths_depth16": deep_paths,
+}
+
+
 CONFIGS = {
     1: ("flat_editor_replay_1k", lambda: editor_replay(1000)),
     2: ("two_replica_interleaved_10k",

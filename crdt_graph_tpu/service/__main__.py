@@ -1,5 +1,7 @@
 import sys
 
+from ..utils import compcache
 from .http import serve
 
+compcache.enable()
 serve(int(sys.argv[1]) if len(sys.argv) > 1 else 8900)

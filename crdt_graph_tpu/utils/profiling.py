@@ -27,9 +27,10 @@ import jax
 
 def _force(x):
     """Forced device→host readback — the honest timing barrier (the axon
-    backend's ``block_until_ready`` returns early; a readback cannot)."""
-    return jax.tree_util.tree_map(
-        lambda a: np.asarray(jax.device_get(a)), x)
+    backend's ``block_until_ready`` returns early; a readback cannot).
+    Single source of truth: bench.honest.force."""
+    from ..bench.honest import force
+    return force(x)
 
 
 def timed(fn: Callable[..., Any], *args, repeats: int = 5,

@@ -1,0 +1,110 @@
+"""Worker process for the multi-process distribution test (A8).
+
+Launched twice by tests/test_distributed.py: each process owns 4 virtual
+CPU devices and 4 of the 8 documents; ``jax.distributed.initialize`` wires
+the two processes into one runtime, the docs mesh axis spans the fleet,
+and each process feeds only its local documents through
+``host_local_docs_to_global`` — the exact multi-host recipe
+parallel/distributed.py documents, exercised for real (num_processes=2).
+
+Usage: python tests/_distributed_worker.py PORT PROCESS_ID
+"""
+import os
+import sys
+
+PORT = sys.argv[1]
+PID = int(sys.argv[2])
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+# the axon sitecustomize registers its TPU plugin before this script body
+# runs; env alone is not enough (see utils/hostenv.py) — pin the platform
+# at the config level before any backend initialises
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import workloads  # noqa: E402
+from crdt_graph_tpu.ops import merge  # noqa: E402
+from crdt_graph_tpu.parallel import distributed, mesh as mesh_mod  # noqa: E402
+
+N_PROCS = 2
+DOCS_PER_PROC = 4
+N_PAD = 64
+
+
+def doc_ops(doc_id: int):
+    """Deterministic per-document workload whose CONTENT differs for every
+    doc id (different replica counts → different timestamp sets), so a
+    shard permutation or doc mix-up is detectable."""
+    ops = workloads.chain_workload(2 + doc_id, 60)
+    return mesh_mod._pad_ops_to(ops, N_PAD)
+
+
+def _fingerprints(table):
+    """Per-doc content scalar: sum of visible timestamps (mod a prime)."""
+    import jax.numpy as jnp
+    vis = table.visible
+    ts = jnp.where(vis, table.ts % jnp.int64(1000003), 0)
+    return jnp.sum(ts, axis=-1), table.num_visible
+
+
+def main() -> None:
+    distributed.initialize(f"127.0.0.1:{PORT}", num_processes=N_PROCS,
+                           process_id=PID)
+    assert jax.process_count() == N_PROCS, jax.process_count()
+    assert len(jax.devices()) == N_PROCS * DOCS_PER_PROC
+    assert len(jax.local_devices()) == DOCS_PER_PROC
+
+    mesh = distributed.global_device_mesh(n_ops=1)
+    assert mesh.shape[mesh_mod.DOCS_AXIS] == N_PROCS * DOCS_PER_PROC
+
+    # this process's local document shard
+    my_docs = range(PID * DOCS_PER_PROC, (PID + 1) * DOCS_PER_PROC)
+    local = [doc_ops(d) for d in my_docs]
+    stacked = {k: np.stack([d[k] for d in local]) for k in local[0]}
+    global_ops = distributed.host_local_docs_to_global(stacked, mesh)
+    for v in global_ops.values():
+        assert v.shape[0] == N_PROCS * DOCS_PER_PROC
+
+    table = mesh_mod.batched_materialize(global_ops, mesh)
+
+    from jax.experimental import multihost_utils
+    fp, nv = jax.jit(_fingerprints)(table)
+    fp = np.asarray(multihost_utils.process_allgather(fp, tiled=True))
+    num_visible = np.asarray(
+        multihost_utils.process_allgather(nv, tiled=True))
+    fp = fp.reshape(-1)[:N_PROCS * DOCS_PER_PROC]
+    num_visible = num_visible.reshape(-1)[:N_PROCS * DOCS_PER_PROC]
+
+    # every process verifies every document against a local single-device
+    # merge (documents are tiny; the oracle-parity of the kernel itself is
+    # pinned elsewhere — here we check the fleet assembly didn't mix,
+    # permute, or duplicate docs: the timestamp-sum fingerprint differs
+    # per doc by construction)
+    wants = []
+    for d in range(N_PROCS * DOCS_PER_PROC):
+        expected = merge.materialize(
+            {k: jax.device_put(v) for k, v in doc_ops(d).items()})
+        efp, env_ = jax.jit(_fingerprints)(expected)
+        want_fp = int(np.asarray(jax.device_get(efp)))
+        want_nv = int(np.asarray(jax.device_get(env_)))
+        wants.append(want_fp)
+        assert int(num_visible[d]) == want_nv, (d, num_visible[d], want_nv)
+        assert int(fp[d]) == want_fp, (d, int(fp[d]), want_fp)
+    assert len(set(wants)) == N_PROCS * DOCS_PER_PROC, \
+        "per-doc fingerprints must be distinct for the mix-up check"
+
+    print(f"worker {PID}: OK ({int(num_visible.sum())} visible nodes "
+          f"across {N_PROCS * DOCS_PER_PROC} docs)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,44 @@ def test_nested_tree_reaches_depth():
     assert deepest >= 8
 
 
+@pytest.mark.parametrize("gen,n", [
+    (lambda: workloads.descending_chains(16, 128), 128),
+    (lambda: workloads.comb_pairs(200), 200),
+    (lambda: workloads.deep_paths(4, 403), 403),
+])
+def test_adversarial_generator_oracle_parity(gen, n):
+    arrays = gen()
+    ops = workloads.unpack_ops(arrays)
+    assert len(ops) == n
+    want = oracle_merge(ops).visible_values()
+    t = view.to_host(merge.materialize(
+        {k: np.asarray(v) for k, v in arrays.items()}))
+    vals = list(range(len(ops)))
+    assert view.visible_values(t, vals) == want
+    st = view.statuses(t, len(ops))
+    assert set(st) <= {"applied"}, set(st)
+
+
+def test_deep_paths_reaches_max_depth():
+    arrays = workloads.deep_paths(4, 403, max_depth=16)
+    assert int(arrays["depth"].max()) == 16
+
+
+def test_chain_expected_ts_matches_oracle():
+    arrays = workloads.chain_workload(4, 64)
+    ops = workloads.unpack_ops(arrays)
+    tree = oracle_merge(ops)
+    got = [n for n in _visible_ts(tree)]
+    assert got == list(workloads.chain_expected_ts(4, 64))
+
+
+def _visible_ts(tree):
+    out = []
+    tree.walk(lambda n, acc: (crdt.TAKE, acc.append(n.timestamp) or acc),
+              out)
+    return out
+
+
 def test_runner_smoke():
     from crdt_graph_tpu.bench import runner
     rows = runner.run([1], repeats=1)

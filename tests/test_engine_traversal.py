@@ -124,7 +124,11 @@ def test_views_survive_host_edits():
 
 
 def _big_batch(n0, count=engine.DELTA_THRESHOLD + 1):
-    """A >threshold remote batch (forces the kernel path)."""
+    """A >threshold remote batch that forces the KERNEL path: delivered
+    fully reversed, so the host-first bulk attempt (round-3 cliff fix,
+    engine._apply_bulk) rejects the non-causal order and falls back to
+    the set-join — which reassigns slots and stales outstanding views.
+    (A causal bulk batch now applies host-side and keeps views valid.)"""
     rid = 9
     ops = []
     prev = 0
@@ -132,7 +136,7 @@ def _big_batch(n0, count=engine.DELTA_THRESHOLD + 1):
         ts = rid * 2**32 + n0 + i
         ops.append(crdt.Add(ts, (prev,), f"r{i}"))
         prev = ts
-    return crdt.Batch(tuple(ops))
+    return crdt.Batch(tuple(reversed(ops)))
 
 
 def test_stale_views_fail_loudly_after_kernel_merge():
@@ -152,6 +156,24 @@ def test_stale_views_fail_loudly_after_kernel_merge():
             access()
     # re-fetching yields a live view
     assert e.get(e.visible_paths()[0]).value is not None
+
+
+def test_bulk_causal_apply_keeps_views_valid():
+    """Round-3 cliff fix: a CAUSALLY ordered bulk batch (what anti-entropy
+    delivers) applies through the host mirror in O(delta) — slots are
+    append-only there, so outstanding views survive, and the result
+    matches the kernel set-join bit for bit."""
+    e = engine.init(1)
+    e.add("a").add("b").add("c")
+    n = e.get(e.visible_paths()[1])
+    causal = crdt.Batch(tuple(reversed(_big_batch(0).ops)))
+    e.apply(causal)
+    assert n.value == "b"          # view still live
+    # same converged document as a from-scratch kernel materialisation
+    e2 = engine.init(2)
+    e2.apply(e.operations_since(0))
+    assert e2.visible_values() == e.visible_values()
+    assert e.log_length == 3 + engine.DELTA_THRESHOLD + 1
 
 
 def test_stale_view_identity_and_repr():
