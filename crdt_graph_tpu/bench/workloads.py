@@ -138,7 +138,7 @@ def tombstone_heavy(n_adds: int = 40_000, n_replicas: int = 32,
 
 
 def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
-                   max_depth: int = 16) -> Dict[str, np.ndarray]:
+                   max_depth: int = 1) -> Dict[str, np.ndarray]:
     """Config 5 (and the bench.py headline): packed arrays for
     ``n_replicas`` interleaved flat insertion chains — every replica
     extends its own chain from the shared branch head, so the merge
@@ -189,7 +189,7 @@ def chain_expected_ts(n_replicas: int = 64,
 
 def descending_chains(n_replicas: int = 4096,
                       n_ops: int = 1_000_000,
-                      max_depth: int = 16) -> Dict[str, np.ndarray]:
+                      max_depth: int = 1) -> Dict[str, np.ndarray]:
     """Anchor chains with strictly DESCENDING timestamps — the worst case
     of the nearest-smaller-ancestor chase (ops/merge.py step 9), which
     exits in 0 trips on causal logs but needs its full O(log chain) trips
@@ -221,7 +221,7 @@ def descending_chains(n_replicas: int = 4096,
 
 
 def comb_pairs(n_ops: int = 1_000_000,
-               max_depth: int = 16) -> Dict[str, np.ndarray]:
+               max_depth: int = 1) -> Dict[str, np.ndarray]:
     """Tour-fragmentation worst case for the run-contracted list ranking
     (ops/merge.py step 12): ``n_ops/2`` two-node combs — tooth ``a_k``
     (replica 2) anchored at the sentinel, child ``b_k`` (replica 1)

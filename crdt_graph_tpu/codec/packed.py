@@ -87,6 +87,15 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return cap
 
 
+def _depth_bucket(d: int, cap: int) -> int:
+    """Smallest power-of-two path width ≥ ``d`` (min 1), clipped to the
+    caller's ``max_depth`` cap."""
+    w = 1
+    while w < d:
+        w *= 2
+    return min(w, cap) if d <= cap else w
+
+
 def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
          capacity: Optional[int] = None) -> PackedOps:
     """Flatten an operation (or iterable of operations) into packed arrays.
@@ -95,6 +104,15 @@ def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
     sequential order.  Out-of-range input raises rather than truncating:
     paths longer than ``max_depth`` (re-pack deeper) and timestamps or path
     elements outside ``[0, MAX_TS)`` (the kernel's sentinel space).
+
+    The stored path width is the power-of-two bucket of the batch's
+    actual deepest path, NOT ``max_depth`` (which is only the cap): a
+    flat editor log packs as ``paths[N, 1]`` instead of dragging a
+    ``[N, 16]`` int64 plane through every kernel gather/compare (v5e has
+    no native int64 — the wide plane was measured as a top-3 cost at the
+    1M-op headline).  The kernel re-specialises per (capacity, depth)
+    bucket; the persistent compilation cache (utils/compcache) absorbs
+    the extra variants.
     """
     if isinstance(ops, (Add, Delete, Batch)):
         ops = [ops]
@@ -107,22 +125,25 @@ def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
     if cap < n:
         raise ValueError(f"capacity {cap} < op count {n}")
 
+    deepest = max((len(op.path) for op in flat), default=1)
+    if deepest > max_depth:
+        raise ValueError(
+            f"path depth {deepest} exceeds max_depth {max_depth}; "
+            f"re-pack with a larger max_depth")
+    width = _depth_bucket(deepest, max_depth)
+
     kind = np.full(cap, KIND_PAD, dtype=np.int8)
     ts = np.zeros(cap, dtype=np.int64)
     parent_ts = np.zeros(cap, dtype=np.int64)
     anchor_ts = np.zeros(cap, dtype=np.int64)
     depth = np.zeros(cap, dtype=np.int32)
-    paths = np.zeros((cap, max_depth), dtype=np.int64)
+    paths = np.zeros((cap, width), dtype=np.int64)
     value_ref = np.full(cap, -1, dtype=np.int32)
     pos = np.arange(cap, dtype=np.int32)
     values: List[Any] = []
 
     for i, op in enumerate(flat):
         path = op.path
-        if len(path) > max_depth:
-            raise ValueError(
-                f"path depth {len(path)} exceeds max_depth {max_depth}; "
-                f"re-pack with a larger max_depth")
         d = len(path)
         if any(e < 0 or e >= MAX_TS for e in path) or \
                 (isinstance(op, Add) and not 0 <= op.ts < MAX_TS):
@@ -168,26 +189,28 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
 
     ``b``'s positions are shifted after ``a``'s so first-arrival dedup keeps
     ``a``'s copies — matching sequential application order a-then-b.
+    Differing path widths (depth buckets) widen to the larger.
     """
-    if a.max_depth != b.max_depth:
-        raise ValueError("mismatched max_depth")
     n = a.num_ops + b.num_ops
     cap = _bucket(n)
+    width = max(a.max_depth, b.max_depth)
     out = PackedOps(
         kind=np.full(cap, KIND_PAD, dtype=np.int8),
         ts=np.zeros(cap, dtype=np.int64),
         parent_ts=np.zeros(cap, dtype=np.int64),
         anchor_ts=np.zeros(cap, dtype=np.int64),
         depth=np.zeros(cap, dtype=np.int32),
-        paths=np.zeros((cap, a.max_depth), dtype=np.int64),
+        paths=np.zeros((cap, width), dtype=np.int64),
         value_ref=np.full(cap, -1, dtype=np.int32),
         pos=np.arange(cap, dtype=np.int32),
         values=list(a.values) + list(b.values),
         num_ops=n)
     na, nb = a.num_ops, b.num_ops
-    for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth", "paths"):
+    for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth"):
         getattr(out, name)[:na] = getattr(a, name)[:na]
         getattr(out, name)[na:n] = getattr(b, name)[:nb]
+    out.paths[:na, :a.max_depth] = a.paths[:na]
+    out.paths[na:n, :b.max_depth] = b.paths[:nb]
     out.value_ref[:na] = a.value_ref[:na]
     shifted = b.value_ref[:nb].copy()
     shifted[shifted >= 0] += len(a.values)

@@ -96,7 +96,10 @@ class HostTree:
         t.depth[1:k + 1] = np.asarray(table.depth)[idx]
         t.value_ref[1:k + 1] = np.asarray(table.value_ref)[idx]
         t.tomb[1:k + 1] = np.asarray(table.tombstone)[idx]
-        t.paths[1:k + 1, :] = np.asarray(table.paths)[idx]
+        # the kernel table's path plane is depth-bucketed (codec.packed);
+        # widen into the mirror's full-width zero-padded plane
+        tbl_paths = np.asarray(table.paths)
+        t.paths[1:k + 1, :tbl_paths.shape[1]] = tbl_paths[idx]
         # sibling lists: group children by parent, doc order within group
         hp = t.parent[1:k + 1]
         order = np.lexsort((np.arange(k), hp))      # parent asc, doc asc

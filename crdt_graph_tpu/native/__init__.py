@@ -17,7 +17,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..codec.packed import DEFAULT_MAX_DEPTH, PackedOps, _bucket
+from ..codec.packed import (DEFAULT_MAX_DEPTH, PackedOps, _bucket,
+                            _depth_bucket)
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "fastcodec.cpp")
@@ -93,13 +94,18 @@ def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
 
     kind = np.full(cap, 2, dtype=np.int8)           # KIND_PAD
     kind[:n] = col("kind", np.int8)
+    # shrink the path plane to the batch's depth bucket, matching
+    # packed.pack (the kernel specialises per width; flat logs get [N,1])
+    depth_col = col("depth", np.int32)
+    width = _depth_bucket(int(depth_col.max(initial=1)), max_depth)
     out = PackedOps(
         kind=kind,
         ts=_padded(col("ts", np.int64), cap),
         parent_ts=_padded(col("parent_ts", np.int64), cap),
         anchor_ts=_padded(col("anchor_ts", np.int64), cap),
-        depth=_padded(col("depth", np.int32), cap),
-        paths=_padded2(col("paths", np.int64, (n, max_depth)), cap),
+        depth=_padded(depth_col, cap),
+        paths=_padded2(
+            col("paths", np.int64, (n, max_depth))[:, :width].copy(), cap),
         value_ref=_padded(col("value_ref", np.int32), cap, fill=-1),
         pos=np.arange(cap, dtype=np.int32),
         values=cols["values"],

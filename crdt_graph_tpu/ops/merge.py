@@ -247,19 +247,25 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     canon_pos = lax.cummax(jnp.where(run_start,
                                      jnp.arange(N, dtype=jnp.int32), 0))
     slot_of_sorted = canon_pos + 1
-    # per-op: node slot and duplicate flag (original batch order)
+    # per-op: node slot and duplicate flag (original batch order).
+    # sorted_idx is a permutation — declare indices unique so XLA's TPU
+    # scatter takes the parallel path instead of the serialized
+    # duplicate-safe one (a top cost of the round-2 kernel).
     op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
-        jnp.where(not_big, slot_of_sorted, NULL))
-    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(~run_start & not_big)
+        jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
+    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
+        ~run_start & not_big, unique_indices=True)
 
     # ---- 2. Column index row, shared by the masked path compares below.
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
 
     # ---- 3. Scatter canonical adds into the node table (slots 1..N).
-    tgt = jnp.where(is_canon, slot_of_sorted, NULL)
+    # Non-canonical rows aim out of range (M) and are dropped, leaving the
+    # in-range indices unique — again the parallel scatter path.
+    tgt = jnp.where(is_canon, slot_of_sorted, M)
 
     def scat(init, vals, at=tgt):
-        return init.at[at].set(vals, mode="drop")
+        return init.at[at].set(vals, mode="drop", unique_indices=True)
 
     g = lambda a: a[sorted_idx]  # noqa: E731  original-order field, sorted
     node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
@@ -268,14 +274,15 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
     node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
     node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
-        paths[sorted_idx], mode="drop")
+        paths[sorted_idx], mode="drop", unique_indices=True)
     is_node_slot = scat(jnp.zeros(M, bool), is_canon)
 
     # Full materialised path: claimed anchor path with the node's own ts in
     # the last position (Internal/Node.elm:79-82).
     col = jnp.clip(node_depth - 1, 0, D - 1)
     fp = node_claimed.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]))
+        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
+        unique_indices=True)
 
     # ---- 4. Timestamp → slot lookups, batched into ONE searchsorted over
     # the sorted add axis (queries: per-slot parent & anchor, per-op delete
@@ -398,12 +405,13 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     # next sibling within the concatenated child list; the root never sits
     # in a sibling list (its exit token is the chain terminal below)
     sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
-        jnp.where(same_parent, s_slot[1:], -1)).at[ROOT].set(-1)
+        jnp.where(same_parent, s_slot[1:], -1),
+        unique_indices=True).at[ROOT].set(-1)
     # first child of each parent = slot at every parent-run start
     s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
-    fc_tgt = jnp.where(s_start, s_parent, NULL)
+    fc_tgt = jnp.where(s_start, s_parent, M)     # non-starts dropped (OOB)
     first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
-        s_slot, mode="drop").at[NULL].set(-1)
+        s_slot, mode="drop", unique_indices=True).at[NULL].set(-1)
 
     # ---- 10. Euler tour: enter(v) = token v, exit(v) = token M + v.
     # Successors form one chain per tree ending in the self-loop at
@@ -442,8 +450,10 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     same_run = fwd | bwd
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
     rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
-    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(tok)
-    run_e = jnp.zeros(T, jnp.int32).at[rid].max(tok)
+    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(
+        tok, indices_are_sorted=True)
+    run_e = jnp.zeros(T, jnp.int32).at[rid].max(
+        tok, indices_are_sorted=True)
     # direction: +1 when the run's start token links forward (runs never
     # straddle the enter/exit boundary: token M-1 is the parked NULL slot's
     # enter and token M the terminal, neither links ±1)
@@ -526,9 +536,11 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
 
     doc_index = jnp.where(exists, doc_dense, IPOS)
     order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(exists, doc_dense, M)].set(slot_ids, mode="drop")
+        jnp.where(exists, doc_dense, M)].set(
+            slot_ids, mode="drop", unique_indices=True)
     visible_order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(visible, vis_dense, M)].set(slot_ids, mode="drop")
+        jnp.where(visible, vis_dense, M)].set(
+            slot_ids, mode="drop", unique_indices=True)
 
     # ---- 13. Sequential-parity statuses per op.
     status = jnp.full(N, PAD, jnp.int8)

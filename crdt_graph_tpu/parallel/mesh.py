@@ -144,7 +144,17 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
 
 def stack_packed(batches: Sequence[PackedOps]) -> Dict[str, np.ndarray]:
     """Stack per-document packed ops into ``[B, N]`` arrays (N = max,
-    pad-extended) for :func:`batched_materialize`."""
+    pad-extended; path planes widened to the widest depth bucket) for
+    :func:`batched_materialize`."""
     n = max(p.capacity for p in batches)
-    per = [_pad_ops_to(p.arrays(), n) for p in batches]
+    width = max(p.max_depth for p in batches)
+    per = []
+    for p in batches:
+        arrs = dict(p.arrays())
+        if arrs["paths"].shape[1] < width:
+            wide = np.zeros((arrs["paths"].shape[0], width),
+                            dtype=arrs["paths"].dtype)
+            wide[:, :arrs["paths"].shape[1]] = arrs["paths"]
+            arrs["paths"] = wide
+        per.append(_pad_ops_to(arrs, n))
     return {k: np.stack([d[k] for d in per]) for k in per[0]}

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the TPU tunnel until a trivial dispatch succeeds; marker: /tmp/tpu_ok
+rm -f /tmp/tpu_ok
+for i in $(seq 1 40); do
+  echo "attempt $i $(date +%H:%M:%S)" >> /tmp/tpu_wait.log
+  if timeout 90 python -c "
+import numpy as np, jax, jax.numpy as jnp
+x = jax.device_put(np.arange(8, dtype=np.int32))
+print(int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v+1))(x)))))
+" >> /tmp/tpu_wait.log 2>&1; then
+    touch /tmp/tpu_ok
+    echo "TPU OK at $(date +%H:%M:%S)" >> /tmp/tpu_wait.log
+    exit 0
+  fi
+  sleep 30
+done
+echo "TPU never recovered" >> /tmp/tpu_wait.log
+exit 1

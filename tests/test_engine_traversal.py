@@ -158,6 +158,31 @@ def test_stale_views_fail_loudly_after_kernel_merge():
     assert e.get(e.visible_paths()[0]).value is not None
 
 
+def test_kernel_merge_rebuilds_mirror_for_nested_docs():
+    """Regression: the kernel table's path plane is depth-bucketed
+    (narrower than the mirror's max_depth plane); rebuilding the mirror
+    from a nested document's table must widen it, and flat documents must
+    not smear their single path column across the padding."""
+    rid = 9 * 2**32
+    ops = []
+    prev_branch = (0,)
+    for i in range(1, engine.DELTA_THRESHOLD + 8):
+        ts = rid + i
+        if i % 3 == 1 and len(prev_branch) < 4:
+            ops.append(crdt.Add(ts, prev_branch, f"b{i}"))
+            prev_branch = prev_branch[:-1] + (ts, 0)
+        else:
+            ops.append(crdt.Add(ts, prev_branch[:-1] + (0,), f"n{i}"))
+    e = engine.init(1)
+    e.apply(crdt.Batch(tuple(reversed(ops))))   # kernel path (non-causal)
+    # mirror reads (paths, traversal) must agree with the oracle's causal
+    # replay of the same op set
+    o = crdt.init(2).apply(crdt.Batch(tuple(ops)))
+    assert e.visible_values() == o.visible_values()
+    for p in e.visible_paths()[:10]:
+        assert e.get_value(p) == o.get_value(p)
+
+
 def test_bulk_causal_apply_keeps_views_valid():
     """Round-3 cliff fix: a CAUSALLY ordered bulk batch (what anti-entropy
     delivers) applies through the host mirror in O(delta) — slots are
