@@ -152,6 +152,7 @@ def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
     anchor = np.where(counter == 1, 0, ts - 1)
     paths = np.zeros((n, max_depth), dtype=np.int64)
     paths[:, 0] = anchor
+    idx = np.arange(n, dtype=np.int32)
     return {
         "kind": np.zeros(n, dtype=np.int8),           # all adds
         "ts": ts,
@@ -159,8 +160,12 @@ def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
         "anchor_ts": anchor,
         "depth": np.ones(n, dtype=np.int32),
         "paths": paths,
-        "value_ref": np.arange(n, dtype=np.int32),
-        "pos": np.arange(n, dtype=np.int32),
+        "value_ref": idx.copy(),
+        "pos": idx.copy(),
+        # link hints: each op's anchor is the previous op in its block
+        "parent_pos": np.full(n, -1, dtype=np.int32),
+        "anchor_pos": np.where(counter == 1, -1, idx - 1).astype(np.int32),
+        "target_pos": np.full(n, -1, dtype=np.int32),
     }
 
 
@@ -205,9 +210,12 @@ def descending_chains(n_replicas: int = 4096,
     ts = rid * OFFSET + counter
     # within a round, op k anchors at op k-1; round heads anchor at 0
     anchor = np.concatenate([[0], ts[:-1]])
-    anchor[np.arange(0, n, n_replicas)] = 0
+    round_head = np.zeros(n, bool)
+    round_head[np.arange(0, n, n_replicas)] = True
+    anchor[round_head] = 0
     paths = np.zeros((n, max_depth), dtype=np.int64)
     paths[:, 0] = anchor
+    idx = np.arange(n, dtype=np.int32)
     return {
         "kind": np.zeros(n, dtype=np.int8),
         "ts": ts,
@@ -215,8 +223,11 @@ def descending_chains(n_replicas: int = 4096,
         "anchor_ts": anchor,
         "depth": np.ones(n, dtype=np.int32),
         "paths": paths,
-        "value_ref": np.arange(n, dtype=np.int32),
-        "pos": np.arange(n, dtype=np.int32),
+        "value_ref": idx.copy(),
+        "pos": idx.copy(),
+        "parent_pos": np.full(n, -1, dtype=np.int32),
+        "anchor_pos": np.where(round_head, -1, idx - 1).astype(np.int32),
+        "target_pos": np.full(n, -1, dtype=np.int32),
     }
 
 
@@ -242,6 +253,9 @@ def comb_pairs(n_ops: int = 1_000_000,
     anchor[1::2] = a_ts
     paths = np.zeros((n, max_depth), dtype=np.int64)
     paths[:, 0] = anchor
+    idx = np.arange(n, dtype=np.int32)
+    anchor_pos = np.full(n, -1, dtype=np.int32)
+    anchor_pos[1::2] = idx[0::2]
     return {
         "kind": np.zeros(n, dtype=np.int8),
         "ts": ts,
@@ -249,8 +263,11 @@ def comb_pairs(n_ops: int = 1_000_000,
         "anchor_ts": anchor,
         "depth": np.ones(n, dtype=np.int32),
         "paths": paths,
-        "value_ref": np.arange(n, dtype=np.int32),
-        "pos": np.arange(n, dtype=np.int32),
+        "value_ref": idx.copy(),
+        "pos": idx.copy(),
+        "parent_pos": np.full(n, -1, dtype=np.int32),
+        "anchor_pos": anchor_pos,
+        "target_pos": np.full(n, -1, dtype=np.int32),
     }
 
 
@@ -299,6 +316,12 @@ def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
     depth[base] = max_depth
     paths[base, :max_depth - 1] = branch
     paths[base, max_depth - 1] = canchor
+    idx = np.arange(n, dtype=np.int32)
+    parent_pos = np.full(n, -1, dtype=np.int32)
+    parent_pos[1:n_skel] = idx[:n_skel - 1]       # skeleton chains down
+    parent_pos[base] = n_skel - 1                 # deepest branch node
+    anchor_pos = np.full(n, -1, dtype=np.int32)
+    anchor_pos[base] = np.where(first, -1, idx[base] - 1)
     return {
         "kind": kind,
         "ts": ts,
@@ -306,8 +329,11 @@ def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
         "anchor_ts": anchor,
         "depth": depth,
         "paths": paths,
-        "value_ref": np.arange(n, dtype=np.int32),
-        "pos": np.arange(n, dtype=np.int32),
+        "value_ref": idx.copy(),
+        "pos": idx.copy(),
+        "parent_pos": parent_pos,
+        "anchor_pos": anchor_pos,
+        "target_pos": np.full(n, -1, dtype=np.int32),
     }
 
 

@@ -20,10 +20,27 @@ Layout (N = padded op count, D = maximum path length):
 - ``pos``        i32[N]  — position in the original batch order; the kernel
                            uses it for first-arrival-wins dedup and for
                            sequential-parity statuses
+- ``parent_pos`` i32[N]  — batch position of the Add that created this
+                           op's tree parent (-1 = root level / not in
+                           batch); ingest-resolved LINK HINT, see below
+- ``anchor_pos`` i32[N]  — adds: batch position of the anchor's Add
+                           (-1 = sentinel / not in batch)
+- ``target_pos`` i32[N]  — deletes: batch position of the target's Add
 
 Timestamps are int64: ``replica_id * 2**32 + counter`` exceeds int32 by
 design (core/timestamp.py).  Shapes are padded to buckets (powers of two) so
 jit caches stay small.
+
+**Link hints.**  The host walks every op once at ingest anyway, so it
+resolves timestamp references (anchor / parent / delete target) to batch
+POSITIONS here, with one dict — and the device kernel then turns each
+reference into one verified int32 gather instead of re-joining 4 queries
+per op against the sorted timestamp axis on every merge (the join was a
+top cost of the round-2 kernel on v5e).  Hints are advisory: the kernel
+verifies ``ts[hint] == referenced_ts`` on device and falls back to the
+full sort-join if ANY hint fails to verify, so a wrong or missing hint
+can never change semantics, only speed.  ``-1`` means "not resolved";
+raw-array callers that provide no hint columns at all get the join path.
 """
 from __future__ import annotations
 
@@ -62,6 +79,22 @@ class PackedOps:
     pos: np.ndarray
     values: List[Any]
     num_ops: int  # real (unpadded) op count
+    # link hints (see module docstring); default -1 = join fallback
+    parent_pos: Optional[np.ndarray] = None
+    anchor_pos: Optional[np.ndarray] = None
+    target_pos: Optional[np.ndarray] = None
+    # host-side ts -> first add position index, cached so engine concat
+    # chains don't rebuild it per bulk apply (not a device field)
+    ts_index: Optional[dict] = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        cap = self.capacity
+        if self.parent_pos is None:
+            self.parent_pos = np.full(cap, -1, dtype=np.int32)
+        if self.anchor_pos is None:
+            self.anchor_pos = np.full(cap, -1, dtype=np.int32)
+        if self.target_pos is None:
+            self.target_pos = np.full(cap, -1, dtype=np.int32)
 
     @property
     def capacity(self) -> int:
@@ -77,7 +110,23 @@ class PackedOps:
             "kind": self.kind, "ts": self.ts, "parent_ts": self.parent_ts,
             "anchor_ts": self.anchor_ts, "depth": self.depth,
             "paths": self.paths, "value_ref": self.value_ref, "pos": self.pos,
+            "parent_pos": self.parent_pos, "anchor_pos": self.anchor_pos,
+            "target_pos": self.target_pos,
         }
+
+    def index(self) -> dict:
+        """ts → first add batch position (built once, then cached)."""
+        if self.ts_index is None:
+            idx: dict = {}
+            kinds = self.kind
+            tss = self.ts
+            for i in range(self.num_ops):
+                if kinds[i] == KIND_ADD:
+                    t = int(tss[i])
+                    if t not in idx:
+                        idx[t] = i
+            self.ts_index = idx
+        return self.ts_index
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -165,9 +214,29 @@ def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
             anchor_ts[i] = path[-1] if path else 0
             parent_ts[i] = path[-2] if len(path) >= 2 else 0
 
+    # link hints: resolve ts references to batch positions (first add wins,
+    # matching the kernel's first-arrival dedup); -1 = not in this batch
+    first: dict = {}
+    for i, op in enumerate(flat):
+        if isinstance(op, Add) and op.ts not in first:
+            first[op.ts] = i
+    parent_pos = np.full(cap, -1, dtype=np.int32)
+    anchor_pos = np.full(cap, -1, dtype=np.int32)
+    target_pos = np.full(cap, -1, dtype=np.int32)
+    for i in range(n):
+        if parent_ts[i]:
+            parent_pos[i] = first.get(int(parent_ts[i]), -1)
+        if kind[i] == KIND_ADD:
+            if anchor_ts[i]:
+                anchor_pos[i] = first.get(int(anchor_ts[i]), -1)
+        elif ts[i]:
+            target_pos[i] = first.get(int(ts[i]), -1)
+
     return PackedOps(kind=kind, ts=ts, parent_ts=parent_ts,
                      anchor_ts=anchor_ts, depth=depth, paths=paths,
-                     value_ref=value_ref, pos=pos, values=values, num_ops=n)
+                     value_ref=value_ref, pos=pos, values=values, num_ops=n,
+                     parent_pos=parent_pos, anchor_pos=anchor_pos,
+                     target_pos=target_pos, ts_index=first)
 
 
 def unpack(packed: PackedOps) -> List[Operation]:
@@ -215,6 +284,38 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     shifted = b.value_ref[:nb].copy()
     shifted[shifted >= 0] += len(a.values)
     out.value_ref[na:n] = shifted
+
+    # Link hints: each side keeps its internal resolutions (b's shifted by
+    # na) and re-resolves its UNRESOLVED refs through the other side's
+    # cached ts index, so hint coverage stays exhaustive for the union —
+    # the kernel's hinted path relies on "every in-batch reference has a
+    # hint" (ops/merge.py step 4).  Typical anti-entropy (old log + new
+    # delta) leaves a's unresolved set empty, so the extra pass is O(new
+    # cross-references), not O(log).
+    a_index, b_index = a.index(), b.index()
+
+    def _fill(side, other_index, base, other_base, count):
+        for name, ref_col in (("parent_pos", "parent_ts"),
+                              ("anchor_pos", "anchor_ts"),
+                              ("target_pos", "ts")):
+            h = getattr(side, name)[:count].copy()
+            refs = getattr(side, ref_col)[:count]
+            unresolved = h < 0
+            h[~unresolved] += base
+            if name == "target_pos":
+                unresolved &= side.kind[:count] == KIND_DELETE
+            elif name == "anchor_pos":
+                unresolved &= side.kind[:count] == KIND_ADD
+            for i in np.nonzero(unresolved & (refs != 0))[0]:
+                hit = other_index.get(int(refs[i]))
+                h[i] = hit + other_base if hit is not None else -1
+            getattr(out, name)[base:base + count] = h
+
+    _fill(a, b_index, 0, na, na)
+    _fill(b, a_index, na, 0, nb)
+    out.ts_index = dict(a_index)
+    for t, i in b_index.items():
+        out.ts_index.setdefault(t, i + na)
     return out
 
 

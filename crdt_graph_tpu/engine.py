@@ -760,6 +760,8 @@ class TpuTree:
                 f, kind=p.kind, ts=p.ts, parent_ts=p.parent_ts,
                 anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
                 value_ref=p.value_ref, pos=p.pos,
+                parent_pos=p.parent_pos, anchor_pos=p.anchor_pos,
+                target_pos=p.target_pos,
                 values=np.frombuffer(json.dumps(p.values).encode(),
                                      np.uint8),
                 meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
@@ -775,7 +777,12 @@ class TpuTree:
             anchor_ts=z["anchor_ts"], depth=z["depth"], paths=z["paths"],
             value_ref=z["value_ref"], pos=z["pos"],
             values=json.loads(bytes(z["values"]).decode()),
-            num_ops=meta["num_ops"])
+            num_ops=meta["num_ops"],
+            # older checkpoints lack hint columns: __post_init__ fills -1
+            # and the kernel's join fallback keeps semantics
+            parent_pos=z["parent_pos"] if "parent_pos" in z.files else None,
+            anchor_pos=z["anchor_pos"] if "anchor_pos" in z.files else None,
+            target_pos=z["target_pos"] if "target_pos" in z.files else None)
         tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
         tree._log = packed_mod.unpack(p)
         tree._packed = p

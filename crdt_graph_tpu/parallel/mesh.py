@@ -68,7 +68,7 @@ def _pad_ops_to(ops: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
         pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
         if k == "kind":
             out[k] = np.pad(v, pad_width, constant_values=KIND_PAD)
-        elif k == "value_ref":
+        elif k in ("value_ref", "parent_pos", "anchor_pos", "target_pos"):
             out[k] = np.pad(v, pad_width, constant_values=-1)
         elif k == "pos":
             out[k] = np.concatenate(
@@ -108,8 +108,17 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
         return run()
 
 
-_batched_kernel = jax.jit(
-    jax.vmap(merge_mod._materialize.__wrapped__))
+def _materialize_join_only(ops):
+    # under vmap, the hinted path's lax.cond lowers to a select that
+    # executes BOTH branches per document — the join would run anyway,
+    # plus hint verification on top.  Batched merges therefore drop the
+    # hint columns and take the join path unconditionally.
+    ops = {k: v for k, v in ops.items()
+           if k not in ("parent_pos", "anchor_pos", "target_pos")}
+    return merge_mod._materialize.__wrapped__(ops)
+
+
+_batched_kernel = jax.jit(jax.vmap(_materialize_join_only))
 
 
 def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,

@@ -177,6 +177,60 @@ def test_out_of_range_replica_id_rejected_loudly():
         packed.pack([Delete((2**62 + 1,))])
 
 
+# -- link hints: the hinted resolution path (ops/merge.py step 4) ---------
+
+def test_hinted_and_joined_paths_agree():
+    """The same batch through the hinted path (pack's link hints) and the
+    join path (hint columns stripped) must produce identical tables."""
+    merged, ops = _random_session(21, n_replicas=3, steps=80)
+    p = packed.pack(ops)
+    arrs = p.arrays()
+    t_hint = view.to_host(merge.materialize(arrs))
+    stripped = {k: v for k, v in arrs.items()
+                if k not in ("parent_pos", "anchor_pos", "target_pos")}
+    t_join = view.to_host(merge.materialize(stripped))
+    assert view.visible_values(t_hint, p.values) == \
+        view.visible_values(t_join, p.values)
+    assert view.statuses(t_hint, p.num_ops) == \
+        view.statuses(t_join, p.num_ops)
+    assert np.array_equal(np.asarray(t_hint.doc_index),
+                          np.asarray(t_join.doc_index))
+
+
+def test_mislinked_hints_fall_back_to_join():
+    """Corrupted hints (every hint pointing at op 0) must not change the
+    result — the kernel verifies on device and falls back to the join."""
+    merged, ops = _random_session(22, n_replicas=3, steps=60)
+    want = merged.visible_values()
+    p = packed.pack(ops)
+    arrs = dict(p.arrays())
+    for k in ("parent_pos", "anchor_pos", "target_pos"):
+        bad = np.asarray(arrs[k]).copy()
+        bad[bad >= 0] = 0           # mislink everything resolvable
+        arrs[k] = bad
+    t = view.to_host(merge.materialize(arrs))
+    assert view.visible_values(t, p.values) == want
+
+
+def test_concat_reresolves_cross_hints():
+    """concat must re-resolve each side's unresolved refs against the
+    other side so the union's hints stay exhaustive (b's ops anchored in
+    a, and a's shuffled ops anchored in b)."""
+    base = [Add(1, (0,), "a"), Add(2, (1,), "b")]
+    delta = [Add(3, (2,), "c"), Delete((1,))]    # refs into base
+    u = packed.concat(packed.pack(base), packed.pack(delta))
+    assert int(u.anchor_pos[2]) == 1             # c's anchor = b (pos 1)
+    assert int(u.target_pos[3]) == 0             # delete target = a
+    t = view.to_host(merge.materialize(u.arrays()))
+    assert view.visible_values(t, u.values) == ["b", "c"]
+    # reverse direction: first part references ops living in the second
+    back = packed.concat(packed.pack(delta), packed.pack(base))
+    assert int(back.anchor_pos[0]) == 3          # c's anchor = b at pos 3
+    assert int(back.target_pos[1]) == 2          # delete target = a at pos 2
+    t2 = view.to_host(merge.materialize(back.arrays()))
+    assert view.visible_values(t2, back.values) == ["b", "c"]
+
+
 # -- randomized causal multi-replica logs vs the oracle -------------------
 
 def _random_session(seed, n_replicas=4, steps=120):
