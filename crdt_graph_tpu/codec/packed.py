@@ -115,17 +115,18 @@ class PackedOps:
         }
 
     def index(self) -> dict:
-        """ts → first add batch position (built once, then cached)."""
+        """ts → first add batch position (built once, then cached).
+
+        Vectorized: a native-parsed million-op batch must not pay a
+        per-op Python loop here (np.unique's return_index gives the
+        first occurrence per timestamp)."""
         if self.ts_index is None:
-            idx: dict = {}
-            kinds = self.kind
-            tss = self.ts
-            for i in range(self.num_ops):
-                if kinds[i] == KIND_ADD:
-                    t = int(tss[i])
-                    if t not in idx:
-                        idx[t] = i
-            self.ts_index = idx
+            n = self.num_ops
+            add_pos = np.nonzero(self.kind[:n] == KIND_ADD)[0]
+            uniq, first_idx = np.unique(self.ts[:n][add_pos],
+                                        return_index=True)
+            self.ts_index = dict(zip(uniq.tolist(),
+                                     add_pos[first_idx].tolist()))
         return self.ts_index
 
 

@@ -109,7 +109,10 @@ def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
         value_ref=_padded(col("value_ref", np.int32), cap, fill=-1),
         pos=np.arange(cap, dtype=np.int32),
         values=cols["values"],
-        num_ops=n)
+        num_ops=n,
+        parent_pos=_padded(col("parent_pos", np.int32), cap, fill=-1),
+        anchor_pos=_padded(col("anchor_pos", np.int32), cap, fill=-1),
+        target_pos=_padded(col("target_pos", np.int32), cap, fill=-1))
     return out
 
 

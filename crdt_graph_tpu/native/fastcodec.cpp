@@ -24,6 +24,7 @@
 #include <cstring>
 #include <limits>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -618,8 +619,34 @@ PyObject* parse_pack(PyObject*, PyObject* args) {
   }
 
   size_t n = cols.kind.size();
+  // Link hints (codec/packed.py module docstring): resolve each op's
+  // timestamp references to batch positions with one hash map, so the
+  // device kernel can use verified gathers instead of a sort-join.
+  // First add with a given ts wins, matching the kernel's dedup.
+  std::vector<int32_t> parent_pos(n, -1), anchor_pos(n, -1),
+      target_pos(n, -1);
+  {
+    std::unordered_map<int64_t, int32_t> first;
+    first.reserve(n * 2);
+    for (size_t i = 0; i < n; ++i) {
+      if (cols.kind[i] == 0) first.emplace(cols.ts[i], int32_t(i));
+    }
+    auto look = [&](int64_t t) -> int32_t {
+      if (!t) return -1;
+      auto it = first.find(t);
+      return it == first.end() ? -1 : it->second;
+    };
+    for (size_t i = 0; i < n; ++i) {
+      if (cols.parent[i]) parent_pos[i] = look(cols.parent[i]);
+      if (cols.kind[i] == 0) {
+        anchor_pos[i] = look(cols.anchor[i]);
+      } else {
+        target_pos[i] = look(cols.ts[i]);
+      }
+    }
+  }
   PyObject* out = Py_BuildValue(
-      "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:n}",
+      "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:n}",
       "kind", bytes_from(cols.kind.data(), n),
       "ts", bytes_from(cols.ts.data(), n * 8),
       "parent_ts", bytes_from(cols.parent.data(), n * 8),
@@ -627,6 +654,9 @@ PyObject* parse_pack(PyObject*, PyObject* args) {
       "depth", bytes_from(cols.depth.data(), n * 4),
       "value_ref", bytes_from(cols.value_ref.data(), n * 4),
       "paths", bytes_from(cols.paths.data(), n * size_t(max_depth) * 8),
+      "parent_pos", bytes_from(parent_pos.data(), n * 4),
+      "anchor_pos", bytes_from(anchor_pos.data(), n * 4),
+      "target_pos", bytes_from(target_pos.data(), n * 4),
       "n", Py_ssize_t(n));
   if (!out) { Py_DECREF(cols.values); return nullptr; }
   if (PyDict_SetItemString(out, "values", cols.values) < 0) {

@@ -22,7 +22,7 @@ def assert_same(payload, max_depth=16):
     got = native.parse_pack(payload, max_depth=max_depth)
     assert got.num_ops == want.num_ops
     for f in ("kind", "ts", "parent_ts", "anchor_ts", "depth", "paths",
-              "value_ref", "pos"):
+              "value_ref", "pos", "parent_pos", "anchor_pos", "target_pos"):
         np.testing.assert_array_equal(getattr(got, f), getattr(want, f), f)
     assert got.values == want.values
     return got
