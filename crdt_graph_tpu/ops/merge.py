@@ -95,14 +95,16 @@ from crdt_graph_tpu/core/node.py.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
+from . import mono_gather
 
 # Per-op result statuses (sequential parity; see module docstring).
 APPLIED = 0
@@ -207,8 +209,14 @@ def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
     return val
 
 
-@jax.jit
-def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
+@functools.partial(jax.jit, static_argnums=(1,))
+def _materialize(ops: Dict[str, jax.Array],
+                 use_pallas: Optional[bool] = None) -> NodeTable:
+    """``use_pallas``: pallas usage for the rank-expansion gathers
+    (ops/mono_gather.py).  None = auto (Mosaic kernel on TPU backends,
+    lax elsewhere); wrappers whose transforms the pallas call must not
+    see (vmapped batched merges, explicitly sharded merges) pass False —
+    a distinct static-arg jit entry, so traces never leak across."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -571,15 +579,25 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     # E(tok) = weight at-or-after tok along the chain; within-run offsets
     # from the global cumsum (forward runs count from the run start,
     # backward runs toward it)
-    def rank_of(a, cse):
-        within = jnp.where(run_fwd[rid],
-                           cse[tok] - cse[run_s[rid]],
-                           cse[run_e[rid] + 1] - cse[tok + 1])
-        e_tok = a[rid] - within
+    # Expand per-run values back to tokens.  These are the kernel's
+    # monotone-bounded gathers (rid is nondecreasing with increments
+    # ≤ 1), served by the pallas kernel on TPU — one DMA-tiled pass for
+    # all seven rows instead of seven generic 2M-wide XLA gathers.
+    per_run = jnp.stack([
+        run_fwd.astype(jnp.int32),
+        cse_doc[run_s], cse_doc[run_e + 1], a_doc,
+        cse_vis[run_s], cse_vis[run_e + 1], a_vis,
+    ])
+    ex = mono_gather.monotone_gather(per_run, rid, use_pallas=use_pallas)
+    rf_t = ex[0].astype(bool)
+
+    def rank_of(ws_t, we1_t, a_t, cse):
+        within = jnp.where(rf_t, cse[:T] - ws_t, we1_t - cse[1:T + 1])
+        e_tok = a_t - within
         return e_tok[ROOT] - e_tok[:M]
 
-    doc_dense = rank_of(a_doc, cse_doc)
-    vis_dense = rank_of(a_vis, cse_vis)
+    doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
+    vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
 
     doc_index = jnp.where(exists, doc_dense, IPOS)
     order = jnp.full(M, NULL, jnp.int32).at[
@@ -630,7 +648,8 @@ def _materialize(ops: Dict[str, jax.Array]) -> NodeTable:
         status=status)
 
 
-def materialize(ops: Dict[str, jax.Array]) -> NodeTable:
+def materialize(ops: Dict[str, jax.Array],
+                use_pallas: Optional[bool] = None) -> NodeTable:
     """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
 
     Timestamps are int64, so the kernel requires 64-bit mode; if the host
@@ -639,6 +658,6 @@ def materialize(ops: Dict[str, jax.Array]) -> NodeTable:
     flag.
     """
     if jax.config.jax_enable_x64:
-        return _materialize(ops)
+        return _materialize(ops, use_pallas)
     with jax.enable_x64(True):
-        return _materialize(ops)
+        return _materialize(ops, use_pallas)

@@ -1,0 +1,121 @@
+"""Pallas TPU kernel: bounded-span monotone gather (the P4 native layer).
+
+``out[v, t] = values[v, rid[t]]`` where ``rid`` is NONDECREASING with
+increments ≤ 1 — exactly the shape of the merge kernel's run-id
+expansions (ops/merge.py step 12: ``run_fwd[rid]``, per-run weight
+prefix ``a[rid]``, …).  XLA lowers these as generic random gathers over
+the 2M-token axis; this kernel exploits the monotone structure instead:
+
+- a tile of ``TILE`` tokens can only reference ``values`` rows in
+  ``[rid[t0], rid[t0] + TILE]`` (increments ≤ 1), so each grid step DMAs
+  one bounded slice HBM→VMEM, with the per-tile start offsets
+  scalar-prefetched (``rid[::TILE]`` computed on device);
+- the in-tile gather is an EXACT one-hot f32 matmul on the MXU
+  (`(V, SPAN) × (SPAN, TILE)`): every value this kernel moves (token
+  ids, weight prefix sums) is < 2^24, so float32 represents it exactly;
+  the one-hot contraction sums exactly one term per output.
+
+Numerical-safety guard: the wrapper refuses (falls back to lax) when any
+input could reach 2^24.  The lax fallback (`_lax_gather`) is the
+reference semantics; CPU/interpret tests pin kernel == fallback.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+TILE = 512       # tokens per grid step
+SPAN = TILE + 128  # values rows DMA'd per tile (≥ TILE+1; 128-lane pad)
+
+try:  # pallas is TPU/Mosaic; keep importable on bare CPU builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+F24 = 1 << 24    # float32 exact-integer bound
+
+
+def _lax_gather(values: jax.Array, rid: jax.Array) -> jax.Array:
+    """Reference semantics: plain XLA gather."""
+    return values[:, rid]
+
+
+if HAVE_PALLAS:
+    def _kernel(starts_ref, rid_ref, vals_hbm, out_ref, scratch, sem):
+        i = pl.program_id(0)
+        r0 = starts_ref[i]
+        copy = pltpu.make_async_copy(
+            vals_hbm.at[:, pl.ds(r0, SPAN)], scratch, sem)
+        copy.start()
+        copy.wait()
+        # off[t] = rid[t] - r0 ∈ [0, TILE]; one-hot over the SPAN axis
+        off = rid_ref[0, :] - r0
+        onehot = (off[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (TILE, SPAN), 1)).astype(jnp.float32)
+        vals_f = scratch[...].astype(jnp.float32)          # [V, SPAN]
+        out = jax.lax.dot_general(
+            vals_f, onehot, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [V, TILE]
+        out_ref[...] = out.astype(jnp.int32)
+
+    def _pallas_call(vals_pad, rid2d, starts, v, tiles, interpret):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(tiles,),
+            in_specs=[
+                pl.BlockSpec((1, TILE), lambda i, starts: (i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((v, TILE), lambda i, starts: (0, i)),
+            scratch_shapes=[
+                pltpu.VMEM((v, SPAN), jnp.int32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((v, tiles * TILE), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, rid2d, vals_pad)
+
+
+def monotone_gather(values: jax.Array, rid: jax.Array,
+                    use_pallas: bool | None = None,
+                    interpret: bool = False) -> jax.Array:
+    """``values[:, rid]`` for nondecreasing ``rid`` with increments ≤ 1.
+
+    values: i32[V, R]; rid: i32[T].  Returns i32[V, T].
+    ``use_pallas=None`` auto-selects: the Mosaic kernel on TPU backends,
+    the lax gather elsewhere.  Falls back to lax whenever the exactness
+    precondition (all magnitudes < 2^24) cannot be guaranteed from
+    shapes alone.
+    """
+    v, r = values.shape
+    t = rid.shape[0]
+    # test hook: run the Mosaic kernel through the interpreter on CPU so
+    # the full merge kernel can be exercised with the pallas path green
+    # without a chip (tests/test_mono_gather.py)
+    if use_pallas and os.environ.get("GRAFT_PALLAS_INTERPRET") == "1":
+        interpret = True
+    if use_pallas is None:
+        use_pallas = HAVE_PALLAS and not interpret and \
+            jax.default_backend() == "tpu"
+    # shape-derived exactness guard: token ids < T, run values < R;
+    # weights are bounded by T as well (prefix sums of 0/1 weights)
+    if not (use_pallas or interpret) or not HAVE_PALLAS or \
+            max(r, t) >= F24 or v > 8:
+        return _lax_gather(values, rid)
+
+    tiles = -(-t // TILE)
+    t_pad = tiles * TILE
+    rid_pad = jnp.pad(rid.astype(jnp.int32), (0, t_pad - t), mode="edge")
+    vals_pad = jnp.pad(values.astype(jnp.int32), ((0, 0), (0, SPAN)))
+    starts = rid_pad[::TILE]
+    rid2d = rid_pad.reshape(tiles, TILE)
+    out = _pallas_call(vals_pad, rid2d, starts, v, tiles, interpret)
+    return out[:, :t]

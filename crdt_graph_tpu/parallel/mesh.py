@@ -97,10 +97,12 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
 
     def run():
         # device_put must sit inside the x64 scope: outside it JAX silently
-        # downcasts int64 host arrays to int32, truncating timestamps
+        # downcasts int64 host arrays to int32, truncating timestamps.
+        # The pallas rank gather is not partition-aware, so explicitly
+        # sharded merges pin the lax path (distinct static-arg jit entry).
         device_ops = {k: jax.device_put(v, NamedSharding(mesh, P(OPS_AXIS)))
                       for k, v in padded.items()}
-        return merge_mod.materialize(device_ops)
+        return merge_mod.materialize(device_ops, use_pallas=False)
 
     if jax.config.jax_enable_x64:
         return run()
@@ -112,10 +114,12 @@ def _materialize_join_only(ops):
     # under vmap, the hinted path's lax.cond lowers to a select that
     # executes BOTH branches per document — the join would run anyway,
     # plus hint verification on top.  Batched merges therefore drop the
-    # hint columns and take the join path unconditionally.
+    # hint columns and take the join path unconditionally, and pin the
+    # pallas rank gather off (use_pallas=False): the pallas call must
+    # not trace under vmap.
     ops = {k: v for k, v in ops.items()
            if k not in ("parent_pos", "anchor_pos", "target_pos")}
-    return merge_mod._materialize.__wrapped__(ops)
+    return merge_mod._materialize.__wrapped__(ops, False)
 
 
 _batched_kernel = jax.jit(jax.vmap(_materialize_join_only))
