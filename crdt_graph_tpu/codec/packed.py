@@ -257,9 +257,13 @@ def unpack(packed: PackedOps) -> List[Operation]:
 def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     """Concatenate two packed batches (the semilattice union before a merge).
 
-    ``b``'s positions are shifted after ``a``'s so first-arrival dedup keeps
-    ``a``'s copies — matching sequential application order a-then-b.
-    Differing path widths (depth buckets) widen to the larger.
+    ``a``'s rows precede ``b``'s, and the kernel's stable timestamp sort
+    makes the EARLIEST ARRAY ROW the canonical copy of a duplicate — so
+    first-arrival dedup keeps ``a``'s copies, matching sequential
+    application order a-then-b.  Invariant relied on by the kernel:
+    ``pos == array index`` (the ``pos`` column feeds status/absorption
+    ordering, not dedup).  Differing path widths (depth buckets) widen
+    to the larger.
     """
     n = a.num_ops + b.num_ops
     cap = _bucket(n)

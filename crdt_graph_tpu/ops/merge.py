@@ -236,14 +236,21 @@ def _materialize(ops: Dict[str, jax.Array],
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
 
-    # ---- 1. Sort adds by (ts, pos) as int32 key triples; first arrival of
-    # a timestamp wins (idempotence, Internal/Node.elm:63-65).  Non-adds
-    # sink to the end.  This is the only timestamp-keyed sort; after it,
-    # slot ids are dense int32 ranks whose order IS timestamp order.
+    # ---- 1. Sort adds by timestamp as (hi, lo) int32 key pairs; the sort
+    # is stable, so among duplicate timestamps the FIRST ROW IN THE ARRAY
+    # wins (idempotence, Internal/Node.elm:63-65) — producers keep
+    # ``pos == array index`` (codec/packed.py) so this equals
+    # first-arrival order.  Non-adds sink to the end.  This is the only
+    # timestamp-keyed sort; after it, slot ids are dense int32 ranks
+    # whose order IS timestamp order.
     sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
     ts_hi, ts_lo = _split_ts(sort_ts)
-    s_hi, s_lo, sorted_pos, sorted_idx = lax.sort(
-        (ts_hi, ts_lo, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
+    # lax.sort is stable, so equal timestamps keep batch order and the
+    # pos column needs no key slot; it is re-derived by one gather —
+    # cheaper than carrying a fourth array through the sort network
+    s_hi, s_lo, sorted_idx = lax.sort(
+        (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+    sorted_pos = pos[sorted_idx]
     sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
         (s_lo.astype(jnp.int64) + 2**31)
     run_start = jnp.concatenate(
@@ -454,14 +461,17 @@ def _materialize(ops: Dict[str, jax.Array],
     skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
     neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
-    s_parent, _, _, s_slot = lax.sort(
-        (skey, ggrp, neg_slot, slot_ids), num_keys=3)
+    # the negated-slot key doubles as the payload: forest rows recover
+    # their slot as -neg, parked rows (IPOS) map out of range and their
+    # scatters drop — no fourth array through the sort network
+    s_parent, _, s_neg = lax.sort((skey, ggrp, neg_slot), num_keys=3)
+    s_slot = jnp.where(s_neg == IPOS, M, -s_neg)
     same_parent = s_parent[1:] == s_parent[:-1]
     # next sibling within the concatenated child list; the root never sits
     # in a sibling list (its exit token is the chain terminal below)
     sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
         jnp.where(same_parent, s_slot[1:], -1),
-        unique_indices=True).at[ROOT].set(-1)
+        mode="drop", unique_indices=True).at[ROOT].set(-1)
     # first child of each parent = slot at every parent-run start
     s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
     fc_tgt = jnp.where(s_start, s_parent, M)     # non-starts dropped (OOB)
