@@ -137,6 +137,39 @@ def _child() -> None:
     }), flush=True)
 
 
+def _precheck() -> None:
+    """Trivial dispatch + readback on the driver-selected backend (child
+    process).  Exercises exactly the path a wedged TPU tunnel blocks."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(np.arange(8, dtype=np.int32))
+    val = int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v + 1))(x))))
+    assert val == 36
+    print(f"bench: precheck ok on {jax.devices()[0].device_kind}",
+          file=sys.stderr, flush=True)
+
+
+def _tunnel_alive(env: dict, timeout_s: int = 240) -> bool:
+    """A wedged device tunnel hangs every dispatch forever (observed
+    round 3: a SIGKILLed client left the terminal claim stuck for hours).
+    Probing with a trivial dispatch first keeps the full-size attempts —
+    and their 10-minute timeouts — for a backend that actually answers;
+    on a dead tunnel the bench goes straight to the CPU fallback instead
+    of burning the driver's budget."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--precheck"],
+            env=env, timeout=timeout_s)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"bench: tunnel precheck timed out after {timeout_s}s",
+              file=sys.stderr, flush=True)
+        return False
+
+
 def _run_child(env: dict, timeout_s: int) -> int:
     try:
         proc = subprocess.run(
@@ -152,13 +185,20 @@ def _run_child(env: dict, timeout_s: int) -> int:
 def main() -> None:
     _warn_siblings()
     env = dict(os.environ)
-    for attempt in range(TPU_ATTEMPTS):
-        print(f"bench: attempt {attempt + 1}/{TPU_ATTEMPTS} "
+    alive = _tunnel_alive(env)
+    if not alive:
+        print("bench: retrying tunnel precheck once after 60s",
+              file=sys.stderr, flush=True)
+        time.sleep(60)
+        alive = _tunnel_alive(env)
+    attempts = TPU_ATTEMPTS if alive else 0
+    for attempt in range(attempts):
+        print(f"bench: attempt {attempt + 1}/{attempts} "
               "(driver-selected backend)", file=sys.stderr, flush=True)
         rc = _run_child(env, TPU_TIMEOUT_S)
         if rc == 0:
             return
-        if attempt < TPU_ATTEMPTS - 1:
+        if attempt < attempts - 1:
             pause = BACKOFF_S[min(attempt, len(BACKOFF_S) - 1)]
             print(f"bench: rc={rc}; backing off {pause}s before retry",
                   file=sys.stderr, flush=True)
@@ -175,5 +215,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child()
+    elif "--precheck" in sys.argv:
+        _precheck()
     else:
         main()

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Slow poll: one 60s TPU attempt every 5 min, up to 36 tries (~3h).
+rm -f /tmp/tpu_ok
+for i in $(seq 1 36); do
+  echo "slowpoll $i $(date +%H:%M:%S)" >> /tmp/tpu_slowpoll.log
+  if timeout 60 python -c "
+import numpy as np, jax, jax.numpy as jnp
+x = jax.device_put(np.arange(8, dtype=np.int32))
+print(int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v+1))(x)))))
+" >> /tmp/tpu_slowpoll.log 2>&1; then
+    touch /tmp/tpu_ok
+    echo "TPU OK at $(date +%H:%M:%S)" >> /tmp/tpu_slowpoll.log
+    exit 0
+  fi
+  sleep 240
+done
+exit 1
