@@ -81,14 +81,58 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
     return out
 
 
+_CLOSED_FORMS = {
+    5: lambda: workloads.chain_expected_ts(64, 1_000_000),
+    6: lambda: workloads.descending_expected_ts(4096, 1_000_000),
+    7: lambda: workloads.comb_expected_ts(1_000_000),
+    8: lambda: workloads.deep_expected_ts(64, 1_000_000),
+}
+
+
+def _crosscheck(cid: int, raw, arrs: Dict[str, np.ndarray]) -> str:
+    """Assert the merged VISIBLE SEQUENCE at full benchmark scale — an
+    order check, not a count check (VERDICT r2 weak-4): op-list configs
+    replay through the host mirror (itself pinned against the oracle);
+    array configs compare against their closed-form expectation."""
+    from ..core.operation import Add
+    from ..host_tree import HostTree
+    from ..ops import view
+
+    # numpy arrays go straight to materialize: a device_put out here
+    # would sit OUTSIDE its enable_x64 scope and silently truncate the
+    # int64 timestamps (the mesh.py footgun)
+    t = view.to_host(merge.materialize(arrs))
+    nv = int(t.num_visible)
+    vo = np.asarray(t.visible_order)[:nv]
+    got = np.asarray(t.ts)[vo]
+    if isinstance(raw, dict):
+        want = _CLOSED_FORMS[cid]()
+    else:
+        m = HostTree(16)
+        for op in raw:
+            if isinstance(op, Add):
+                m.apply_add(op.ts, tuple(op.path), op.value)
+            else:
+                m.apply_delete(tuple(op.path))
+        want = np.array([int(m.ts[s]) for s in m.iter_visible()],
+                        dtype=np.int64)
+    if got.shape == want.shape and np.array_equal(got, want):
+        return "exact"
+    return (f"MISMATCH (got {got.shape[0]} visible, "
+            f"want {want.shape[0]})")
+
+
 def run(config_ids: Optional[Iterable[int]] = None,
-        repeats: int = 5) -> list:
+        repeats: int = 5, check: bool = True) -> list:
     results = []
     for cid in (config_ids or sorted(workloads.CONFIGS)):
         name, gen = workloads.CONFIGS[cid]
-        ops = _as_arrays(gen())
+        raw = gen()
+        ops = _as_arrays(raw)
         stats = time_merge(ops, repeats=repeats)
         row = {"config": cid, "name": name, **stats}
+        if check:
+            row["order_check"] = _crosscheck(cid, raw, ops)
         results.append(row)
         print(json.dumps(row), flush=True)
     return results

@@ -232,14 +232,18 @@ def descending_chains(n_replicas: int = 4096,
 
 
 def comb_pairs(n_ops: int = 1_000_000,
-               max_depth: int = 1) -> Dict[str, np.ndarray]:
+               max_depth: int = 2) -> Dict[str, np.ndarray]:
     """Tour-fragmentation worst case for the run-contracted list ranking
     (ops/merge.py step 12): ``n_ops/2`` two-node combs — tooth ``a_k``
-    (replica 2) anchored at the sentinel, child ``b_k`` (replica 1)
-    anchored at ``a_k`` with a smaller timestamp.  The Euler tour
-    alternates between the two slot halves on every token, so maximal
-    ±1-stride runs have length ~1 and Wyllie runs at full 2M width for
-    its whole O(log T) trip budget."""
+    (replica 2) anchored at the root sentinel, and ``b_k`` (replica 1,
+    smaller timestamp) nested as a BRANCH CHILD of ``a_k`` (path
+    ``(a_k, 0)``), so the walk visits ``a_k, b_k, a_{k-1}, b_{k-1}, …``.
+    Teeth occupy the upper slot half and children the lower, so the
+    Euler tour alternates slot halves every 1-2 tokens: maximal
+    ±1-stride runs have length ~1 and Wyllie must run at full 2M width
+    for its whole O(log T) trip budget.  (A sibling-anchored ``b_k``
+    would NOT fragment: the RGA skip-scan drifts it right past every
+    larger-ts tooth and the document collapses to one descending run.)"""
     per = n_ops // 2
     n = per * 2
     k = np.arange(1, per + 1, dtype=np.int64)
@@ -248,25 +252,26 @@ def comb_pairs(n_ops: int = 1_000_000,
     ts = np.empty(n, dtype=np.int64)
     ts[0::2] = a_ts
     ts[1::2] = b_ts
-    anchor = np.empty(n, dtype=np.int64)
-    anchor[0::2] = 0
-    anchor[1::2] = a_ts
     paths = np.zeros((n, max_depth), dtype=np.int64)
-    paths[:, 0] = anchor
+    paths[1::2, 0] = a_ts                 # b's path = (a_k, 0)
+    depth = np.ones(n, dtype=np.int32)
+    depth[1::2] = 2
+    parent_ts = np.zeros(n, dtype=np.int64)
+    parent_ts[1::2] = a_ts
     idx = np.arange(n, dtype=np.int32)
-    anchor_pos = np.full(n, -1, dtype=np.int32)
-    anchor_pos[1::2] = idx[0::2]
+    parent_pos = np.full(n, -1, dtype=np.int32)
+    parent_pos[1::2] = idx[0::2]
     return {
         "kind": np.zeros(n, dtype=np.int8),
         "ts": ts,
-        "parent_ts": np.zeros(n, dtype=np.int64),
-        "anchor_ts": anchor,
-        "depth": np.ones(n, dtype=np.int32),
+        "parent_ts": parent_ts,
+        "anchor_ts": np.zeros(n, dtype=np.int64),   # all sentinel-anchored
+        "depth": depth,
         "paths": paths,
         "value_ref": idx.copy(),
         "pos": idx.copy(),
-        "parent_pos": np.full(n, -1, dtype=np.int32),
-        "anchor_pos": anchor_pos,
+        "parent_pos": parent_pos,
+        "anchor_pos": np.full(n, -1, dtype=np.int32),
         "target_pos": np.full(n, -1, dtype=np.int32),
     }
 
@@ -337,6 +342,45 @@ def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
     }
 
 
+def descending_expected_ts(n_replicas: int = 4096,
+                           n_ops: int = 1_000_000) -> np.ndarray:
+    """Closed-form visible sequence for :func:`descending_chains`: every
+    chain is strictly ts-descending, so each node's T* parent chase
+    exhausts at the branch head — the whole document is one flat branch
+    ordered by timestamp DESCENDING (greedy max-ts linearisation with
+    every op's anchor emitted by the time it is reachable)."""
+    return np.sort(descending_chains(n_replicas, n_ops)["ts"])[::-1].copy()
+
+
+def comb_expected_ts(n_ops: int = 1_000_000) -> np.ndarray:
+    """Closed-form visible sequence for :func:`comb_pairs`: teeth sort
+    ts-descending at the sentinel; each tooth is immediately followed by
+    its (smaller-ts) child."""
+    per = n_ops // 2
+    k = np.arange(per, 0, -1, dtype=np.int64)
+    out = np.empty(2 * per, dtype=np.int64)
+    out[0::2] = 2 * OFFSET + k
+    out[1::2] = 1 * OFFSET + k
+    return out
+
+
+def deep_expected_ts(n_replicas: int = 64, n_ops: int = 1_000_000,
+                     max_depth: int = 16) -> np.ndarray:
+    """Closed-form visible sequence for :func:`deep_paths`: pre-order
+    walks the skeleton chain, then the chains at the deepest branch
+    interleave exactly like :func:`chain_expected_ts` (replica ids
+    descending, counters ascending; replica 1's counters continue past
+    the skeleton)."""
+    n_skel = max_depth - 1
+    skel = np.array([OFFSET + c for c in range(1, max_depth)],
+                    dtype=np.int64)
+    per = (n_ops - n_skel) // n_replicas
+    rids = np.arange(n_replicas, 0, -1, dtype=np.int64)
+    counters = np.arange(1, per + 1, dtype=np.int64)[None, :] + \
+        np.where(rids == 1, n_skel, 0)[:, None]
+    return np.concatenate([skel, (rids[:, None] * OFFSET + counters).ravel()])
+
+
 def unpack_ops(arrs: Dict[str, np.ndarray]) -> List[Operation]:
     """Packed arrays → op list (small sizes only; oracle cross-checks)."""
     out: List[Operation] = []
@@ -365,4 +409,9 @@ CONFIGS = {
     3: ("nested_depth8_8rep_100k", lambda: nested_tree(100_000)),
     4: ("tombstone_heavy_32rep", lambda: tombstone_heavy(40_000)),
     5: ("join_64rep_1M", lambda: chain_workload(64, 1_000_000)),
+    # adversarial kernel worst cases (ids 6-8; not BASELINE configs)
+    6: ("adv_descending_chains_4096rep",
+        lambda: descending_chains(4096, 1_000_000)),
+    7: ("adv_comb_fragmented_tour", lambda: comb_pairs(1_000_000)),
+    8: ("adv_deep_paths_depth16", lambda: deep_paths(64, 1_000_000)),
 }

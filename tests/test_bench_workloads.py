@@ -96,12 +96,30 @@ def _visible_ts(tree):
     return out
 
 
+@pytest.mark.parametrize("gen,exp", [
+    (lambda: workloads.descending_chains(16, 128),
+     lambda: workloads.descending_expected_ts(16, 128)),
+    (lambda: workloads.comb_pairs(200),
+     lambda: workloads.comb_expected_ts(200)),
+    (lambda: workloads.deep_paths(4, 403),
+     lambda: workloads.deep_expected_ts(4, 403)),
+])
+def test_adversarial_closed_forms_match_oracle(gen, exp):
+    """The closed-form visible sequences the full-scale sweep asserts
+    against must themselves match the oracle at small scale."""
+    ops = workloads.unpack_ops(gen())
+    tree = oracle_merge(ops)
+    assert _visible_ts(tree) == list(exp())
+
+
 def test_runner_smoke():
     from crdt_graph_tpu.bench import runner
     rows = runner.run([1], repeats=1)
     assert rows and rows[0]["n_ops"] == 1000
     assert 0 < rows[0]["num_visible"] <= rows[0]["num_nodes"]
     assert rows[0]["ops_per_sec"] > 0
+    assert rows[0]["order_check"] == "exact"
+    assert rows[0]["audit"]["ok"]
 
 
 def test_operations_since_roundtrip_on_workload():
