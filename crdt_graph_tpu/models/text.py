@@ -23,22 +23,15 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
-from .. import engine as tpu_engine
-from ..core import tree as oracle_mod
 from ..core.operation import Batch, Operation
+from .base import ReplicatedModel
 
 
-class TextBuffer:
+class TextBuffer(ReplicatedModel):
     """A replicated text document; see module docstring."""
 
     def __init__(self, replica: int, engine: str = "tpu"):
-        if engine == "tpu":
-            self._t = tpu_engine.init(replica)
-        elif engine == "oracle":
-            self._t = oracle_mod.init(replica)
-        else:
-            raise ValueError(f"unknown engine {engine!r}")
-        self._engine = engine
+        super().__init__(replica, engine)
         # visible-path cache, maintained incrementally across LOCAL edits
         # (splice at the edit index) and invalidated by remote merges —
         # keeps per-edit cost O(op), independent of document length
@@ -140,15 +133,7 @@ class TextBuffer:
             return (0,)
         return self._visible_paths()[index - 1]
 
-    # -- replication ------------------------------------------------------
-
-    @property
-    def replica_id(self) -> int:
-        return self._t.replica_id
-
-    @property
-    def last_operation(self) -> Operation:
-        return self._t.last_operation
+    # -- replication (base class, plus the path-cache invalidation) -------
 
     @staticmethod
     def _iter_leaves(op: Operation):
@@ -157,18 +142,6 @@ class TextBuffer:
 
     def apply(self, delta: Operation) -> "TextBuffer":
         """Merge a remote delta (cursor-stable, idempotent)."""
-        self._t = self._t.apply(delta)
+        super().apply(delta)
         self._pc_valid = False          # remote edits land anywhere
         return self
-
-    def operations_since(self, ts: int) -> Operation:
-        return self._t.operations_since(ts)
-
-    def last_replica_timestamp(self, replica: int) -> int:
-        return self._t.last_replica_timestamp(replica)
-
-    def sync_from(self, peer: "TextBuffer") -> "TextBuffer":
-        """Pull-based anti-entropy: fetch everything newer than the last
-        timestamp seen from the peer (CRDTree.elm:390-418 pattern)."""
-        since = self.last_replica_timestamp(peer.replica_id)
-        return self.apply(peer.operations_since(since))

@@ -1,0 +1,87 @@
+"""The outline model must behave identically over both engines, nest and
+delete subtrees with reference semantics, and converge under concurrent
+editing (models/outline.py)."""
+import pytest
+
+from crdt_graph_tpu.models.outline import OutlineDoc
+
+
+@pytest.fixture(params=["tpu", "oracle"])
+def eng(request):
+    return request.param
+
+
+def test_nesting_and_render(eng):
+    d = OutlineDoc(1, engine=eng)
+    plan = d.add_section("plan")
+    first = d.add_item("write tests", parent=plan)
+    d.add_item("ship", parent=plan, after=first)
+    d.add_item("later", after=plan)
+    assert [(dep, t) for dep, t, _ in d.items()] == [
+        (1, "plan"), (2, "write tests"), (2, "ship"), (1, "later")]
+    assert d.render() == "plan\n  write tests\n  ship\nlater"
+
+
+def test_delete_kills_subtree(eng):
+    d = OutlineDoc(1, engine=eng)
+    sec = d.add_section("sec")
+    d.add_item("child", parent=sec)
+    keep = d.add_item("keep", after=sec)
+    d.delete_item(sec)
+    assert [t for _, t, _ in d.items()] == ["keep"]
+    assert d.items()[0][2] == keep
+
+
+def test_concurrent_merge_converges(eng):
+    a = OutlineDoc(1, engine=eng)
+    b = OutlineDoc(2, engine=eng)
+    sec = a.add_section("agenda")
+    b.apply(a.operations_since(0))
+    # both replicas add under the same section concurrently
+    a.add_item("from-a", parent=sec)
+    b.add_item("from-b", parent=sec)
+    a.sync_from(b)
+    b.sync_from(a)
+    assert a.items() == b.items()
+    # RGA rule: higher timestamp (replica 2) sits nearer the branch head
+    assert [t for _, t, _ in a.items()] == ["agenda", "from-b", "from-a"]
+
+
+def test_engines_agree_on_session():
+    """Same scripted session through both engines → identical documents."""
+    def script(doc):
+        s1 = doc.add_section("one")
+        i = doc.add_item("a", parent=s1)
+        doc.add_item("b", parent=s1, after=i)
+        s2 = doc.add_section("two", after=s1)
+        doc.add_item("c", parent=s2)
+        doc.delete_item(i)
+        return doc
+
+    t = script(OutlineDoc(5, engine="tpu"))
+    o = script(OutlineDoc(5, engine="oracle"))
+    assert [(d, v) for d, v, _ in t.items()] == \
+        [(d, v) for d, v, _ in o.items()]
+    assert t.render() == o.render() == "one\n  b\ntwo\n  c"
+
+
+def test_absorbed_add_returns_none(eng):
+    """Adding under a deleted section (a concurrent delete won) is a
+    success-no-op: add_item returns None instead of crashing (the
+    reference's AlreadyApplied -> Ok contract, CRDTree.elm:318-319)."""
+    d = OutlineDoc(1, engine=eng)
+    sec = d.add_section("sec")
+    d.delete_item(sec)
+    assert d.add_item("child", parent=sec) is None
+    assert len(d) == 0
+
+
+def test_wire_interop_with_text_engine():
+    """Outline deltas ride the same JSON wire as everything else."""
+    from crdt_graph_tpu.codec import json_codec
+    a = OutlineDoc(1)
+    a.add_section("s")
+    wire = json_codec.dumps(a.operations_since(0))
+    b = OutlineDoc(2)
+    b.apply(json_codec.loads(wire))
+    assert b.items() == a.items()
