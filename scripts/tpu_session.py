@@ -1,0 +1,103 @@
+"""One-session TPU measurement: everything we need from a single tunnel
+grant, serially (two clients deadlock the tunnel — see bench.py).
+
+Phases (each prints one JSON line to stdout; progress to stderr):
+1. trivial dispatch + overhead floor
+2. headline 1M merge: honest timing + async-gap audit + closed-form
+   order check
+3. pallas rank-gather A/B: use_pallas True vs False (static-arg variants)
+4. 8-config sweep with full-sequence order checks
+5. scale sweep 250k-2M
+
+Usage: python scripts/tpu_session.py [phases…]   (default: 1 2 3)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from crdt_graph_tpu.utils import compcache
+compcache.enable()
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import honest, runner, workloads
+from crdt_graph_tpu.ops import merge
+
+
+def log(msg):
+    print(f"tpu_session: {msg}", file=sys.stderr, flush=True)
+
+
+def out(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def phase1():
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    log(f"device {dev.device_kind} in {time.perf_counter()-t0:.1f}s")
+    floor = honest.overhead_floor_ms()
+    out({"phase": 1, "device": dev.device_kind,
+         "dispatch_overhead_ms": floor})
+
+
+def phase2():
+    ops = workloads.chain_workload(64, 1_000_000)
+    stats = runner.time_merge(ops, repeats=5, progress=True)
+    expected = jax.device_put(workloads.chain_expected_ts(64, 1_000_000))
+    dev_ops = jax.device_put(ops)
+
+    @jax.jit
+    def _order_ok(o, exp):
+        t = merge._materialize(o)
+        seq = t.ts[t.visible_order]
+        return jnp.all(seq[:exp.shape[0]] == exp)
+
+    ok = bool(np.asarray(jax.device_get(_order_ok(dev_ops, expected))))
+    out({"phase": 2, "headline_1M": stats, "order_exact": ok})
+
+
+def phase3():
+    ops = workloads.chain_workload(64, 1_000_000)
+    dev_ops = jax.device_put(ops)
+
+    def timed(flag):
+        def fn(o):
+            t = merge._materialize(o, flag)
+            return honest.fingerprint((t.doc_index, t.num_visible))
+        s = honest.time_with_readback(fn, dev_ops, repeats=3, log=log)
+        s.pop("last_result", None)
+        return s
+
+    with_pallas = timed(True)
+    without = timed(False)
+    out({"phase": 3, "pallas_rank": with_pallas, "lax_rank": without})
+
+
+def phase4():
+    rows = runner.run(repeats=3)
+    out({"phase": 4, "sweep": rows})
+
+
+def phase5():
+    rows = []
+    for n in (250_000, 500_000, 1_000_000, 2_000_000):
+        stats = runner.time_merge(workloads.chain_workload(64, n),
+                                  repeats=3, audit=False)
+        rows.append({"n_ops": stats["n_ops"], "p50_ms": stats["p50_ms"],
+                     "ops_per_sec": stats["ops_per_sec"]})
+        log(f"scale {n}: {stats['p50_ms']} ms")
+    out({"phase": 5, "scale": rows})
+
+
+if __name__ == "__main__":
+    phases = [int(a) for a in sys.argv[1:]] or [1, 2, 3]
+    for p in phases:
+        log(f"=== phase {p} ===")
+        globals()[f"phase{p}"]()
