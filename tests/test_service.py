@@ -62,6 +62,51 @@ def test_collaboration_roundtrip(server):
     assert a.text() == "hello world"
 
 
+def test_three_client_randomized_convergence(server):
+    """Race coverage at the service level: three clients interleave local
+    edits, pushes, and pulls in random order over real HTTP; everyone
+    (and the server snapshot) must converge to one document."""
+    import random
+    rng = random.Random(13)
+    clients = []
+    for _ in range(3):
+        _, r = req(server, "POST", "/docs/race/replicas")
+        clients.append(TextBuffer(r["replica"]))
+    def push(i):
+        c = clients[i]
+        delta = c.last_operation
+        body = json_codec.dumps(delta)
+        st, _ = req(server, "POST", "/docs/race/ops", body)
+        assert st in (200, 409)
+
+    def pull(i):
+        # full replay every pull: duplicate delivery is normal and must
+        # be absorbed (the idempotence contract under test)
+        _, ops = req(server, "GET", "/docs/race/ops?since=0")
+        clients[i].apply(json_codec.decode(ops))
+
+    for step in range(60):
+        i = rng.randrange(3)
+        roll = rng.random()
+        c = clients[i]
+        if roll < 0.5:
+            n = len(c)
+            if n and rng.random() < 0.3:
+                c.delete(rng.randrange(n))
+            else:
+                c.insert(rng.randrange(n + 1), chr(97 + step % 26))
+            push(i)
+        else:
+            pull(i)
+    for i in range(3):
+        pull(i)
+    _, snap = req(server, "GET", "/docs/race")
+    server_text = "".join(str(v) for v in snap["values"])
+    assert clients[0].text() == clients[1].text() == clients[2].text() \
+        == server_text
+    assert server_text            # non-trivial document
+
+
 def test_duplicate_push_absorbed(server):
     a = TextBuffer(1)
     a.insert(0, "x")
