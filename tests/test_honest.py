@@ -1,0 +1,54 @@
+"""The honest-timing harness (bench/honest.py) is what makes every perf
+number in this repo trustworthy — pin its pieces."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from crdt_graph_tpu.bench import honest
+
+
+def test_fingerprint_depends_on_every_leaf():
+    a = jnp.arange(100, dtype=jnp.int32)
+    b = jnp.ones(7, dtype=jnp.int32)
+    base = int(np.asarray(honest.fingerprint((a, b))))
+    assert int(np.asarray(honest.fingerprint((a, b)))) == base
+    bumped = int(np.asarray(honest.fingerprint((a.at[3].add(1), b))))
+    assert bumped != base
+    bumped2 = int(np.asarray(honest.fingerprint((a, b.at[0].add(1)))))
+    assert bumped2 != base
+
+
+def test_fingerprint_handles_bool_and_float():
+    t = (jnp.array([True, False]), jnp.array([1.5, 2.5]),
+         jnp.arange(3, dtype=jnp.int64))
+    v = int(np.asarray(honest.fingerprint(t)))
+    assert isinstance(v, int)
+
+
+def test_force_returns_host_values():
+    out = honest.force({"x": jnp.arange(4), "y": (jnp.ones(2),)})
+    assert isinstance(out["x"], np.ndarray)
+    assert isinstance(out["y"][0], np.ndarray)
+
+
+def test_time_with_readback_reports_and_returns_result():
+    fn = jax.jit(lambda x: jnp.sum(x) * 2)
+    x = jnp.arange(10, dtype=jnp.int32)
+    stats = honest.time_with_readback(fn, x, repeats=3)
+    assert len(stats["times_s"]) == 3
+    assert stats["p50_ms"] >= 0
+    assert int(stats["last_result"]) == 90
+
+
+def test_audit_passes_for_honest_backend():
+    fn = jax.jit(lambda x: jnp.sum(x * x))
+    x = jnp.arange(1000, dtype=jnp.int32)
+    audit = honest.audit_async_gap(fn, x, expected_s=0.01)
+    assert audit["ok"] is True
+    assert audit["readback_after_sleep_ms"] < 250
+
+
+def test_overhead_floor_small_on_cpu():
+    floor = honest.overhead_floor_ms()
+    assert 0 <= floor < 250
