@@ -209,14 +209,24 @@ def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
     return val
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@functools.partial(jax.jit, static_argnums=(1, 2))
 def _materialize(ops: Dict[str, jax.Array],
-                 use_pallas: Optional[bool] = None) -> NodeTable:
+                 use_pallas: Optional[bool] = None,
+                 hints: Optional[str] = None) -> NodeTable:
     """``use_pallas``: pallas usage for the rank-expansion gathers
     (ops/mono_gather.py).  None = auto (Mosaic kernel on TPU backends,
     lax elsewhere); wrappers whose transforms the pallas call must not
     see (vmapped batched merges, explicitly sharded merges) pass False —
-    a distinct static-arg jit entry, so traces never leak across."""
+    a distinct static-arg jit entry, so traces never leak across.
+
+    ``hints``: link-hint policy for timestamp resolution (step 4).
+    None/"auto" = use hints with a runtime lax.cond fallback to the
+    sort-join when any reference lacks a verified hint; "exhaustive" =
+    trust the producer's hint coverage (pack/concat guarantee it) and
+    compile the hinted path ONLY — no cond, so the trace is vmappable
+    and partitionable and the join never compiles; "join" = ignore
+    hints entirely.  Results are identical across modes for batches
+    with exhaustive hints (pinned by tests)."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -232,6 +242,10 @@ def _materialize(ops: Dict[str, jax.Array],
     ROOT = 0
     NULL = M - 1
     slot_ids = jnp.arange(M, dtype=jnp.int32)
+
+    if hints not in (None, "auto", "exhaustive", "join"):
+        raise ValueError(f"unknown hints mode {hints!r}; expected None, "
+                         "'auto', 'exhaustive', or 'join'")
 
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
@@ -308,12 +322,14 @@ def _materialize(ops: Dict[str, jax.Array],
     # HINTED: when the ingest provided link-hint columns (codec.packed:
     # batch POSITION of each referenced add), each reference is one
     # verified int32 gather — ts[hint] must equal the referenced
-    # timestamp, checked on device.  If ANY nonzero reference lacks a
-    # verified hint (hint-less producer, stale/mislinked hint, or a
-    # genuinely absent target), lax.cond falls back to the full join for
-    # the whole batch — hints are advisory and can cost time, never
-    # correctness.  pack/concat resolve exhaustively, so honest batches
-    # take the fast path whenever they are causally complete.
+    # timestamp, checked on device.  In the default/auto mode, if ANY
+    # nonzero reference lacks a verified hint (hint-less producer,
+    # stale/mislinked hint, or a genuinely absent target), lax.cond
+    # falls back to the full join for the whole batch — hints stay
+    # advisory there.  In "exhaustive" mode the caller VOUCHES for hint
+    # coverage (pack/concat-produced batches) and the join never
+    # compiles — a violated promise there silently mis-resolves
+    # references, which is why the mode is opt-in per call site.
     def _resolve_joined(_):
         queries = jnp.concatenate([
             scat(jnp.zeros(M, jnp.int64), g(parent_ts)),   # node parent ts
@@ -334,8 +350,8 @@ def _materialize(ops: Dict[str, jax.Array],
                 qfound[:M], qfound[M:2 * M],
                 qfound[2 * M:2 * M + N], qfound[2 * M + N:])
 
-    have_hints = all(k in ops for k in
-                     ("parent_pos", "anchor_pos", "target_pos"))
+    have_hints = hints != "join" and all(
+        k in ops for k in ("parent_pos", "anchor_pos", "target_pos"))
     if have_hints:
         def _res(hint, want):
             p = jnp.clip(hint, 0, N - 1)
@@ -343,10 +359,10 @@ def _materialize(ops: Dict[str, jax.Array],
                 (want > 0) & (want < BIG)
             slot = jnp.where(want == 0, ROOT,
                              jnp.where(ok, op_slot[p], NULL))
-            # any nonzero reference WITHOUT a verified hint (missing,
-            # stale, or mislinked — e.g. a hint-less producer) sends the
-            # whole batch through the join: hints are advisory, never
-            # load-bearing for correctness
+            # auto mode: any nonzero reference WITHOUT a verified hint
+            # (missing, stale, or mislinked — e.g. a hint-less producer)
+            # sends the whole batch through the join; exhaustive mode
+            # skips that net by the caller's coverage promise
             miss = (want > 0) & (want < BIG) & ~ok
             return slot.astype(jnp.int32), (want == 0) | ok, miss
 
@@ -362,11 +378,18 @@ def _materialize(ops: Dict[str, jax.Array],
                   scat(jnp.zeros(M, bool), g(pp_found)),
                   scat(jnp.zeros(M, bool), g(aa_found)),
                   tt_found, pp_found)
-        any_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
-            jnp.any(tt_miss & is_del)
-        (pslot, aslot, d_tslot, dp_slot,
-         pfound, afound, d_tfound, dp_found) = lax.cond(
-            any_miss, _resolve_joined, lambda _: hinted, None)
+        if hints == "exhaustive":
+            # producer guarantees every in-batch reference is hinted, so
+            # unresolved == genuinely absent and the hinted results ARE
+            # the answer — no cond, no join in the program at all
+            (pslot, aslot, d_tslot, dp_slot,
+             pfound, afound, d_tfound, dp_found) = hinted
+        else:
+            any_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
+                jnp.any(tt_miss & is_del)
+            (pslot, aslot, d_tslot, dp_slot,
+             pfound, afound, d_tfound, dp_found) = lax.cond(
+                any_miss, _resolve_joined, lambda _: hinted, None)
     else:
         (pslot, aslot, d_tslot, dp_slot,
          pfound, afound, d_tfound, dp_found) = _resolve_joined(None)
@@ -659,7 +682,8 @@ def _materialize(ops: Dict[str, jax.Array],
 
 
 def materialize(ops: Dict[str, jax.Array],
-                use_pallas: Optional[bool] = None) -> NodeTable:
+                use_pallas: Optional[bool] = None,
+                hints: Optional[str] = None) -> NodeTable:
     """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
 
     Timestamps are int64, so the kernel requires 64-bit mode; if the host
@@ -668,6 +692,6 @@ def materialize(ops: Dict[str, jax.Array],
     flag.
     """
     if jax.config.jax_enable_x64:
-        return _materialize(ops, use_pallas)
+        return _materialize(ops, use_pallas, hints)
     with jax.enable_x64(True):
-        return _materialize(ops, use_pallas)
+        return _materialize(ops, use_pallas, hints)

@@ -99,7 +99,10 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
         # device_put must sit inside the x64 scope: outside it JAX silently
         # downcasts int64 host arrays to int32, truncating timestamps.
         # The pallas rank gather is not partition-aware, so explicitly
-        # sharded merges pin the lax path (distinct static-arg jit entry).
+        # sharded merges pin the lax path; hints keep the default auto
+        # mode (the cond's scalar predicate partitions fine under SPMD,
+        # and the join fallback stays available for hint-less or
+        # mislinked inputs — e.g. restored old checkpoints).
         device_ops = {k: jax.device_put(v, NamedSharding(mesh, P(OPS_AXIS)))
                       for k, v in padded.items()}
         return merge_mod.materialize(device_ops, use_pallas=False)
@@ -110,29 +113,41 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
         return run()
 
 
-def _materialize_join_only(ops):
-    # under vmap, the hinted path's lax.cond lowers to a select that
-    # executes BOTH branches per document — the join would run anyway,
-    # plus hint verification on top.  Batched merges therefore drop the
-    # hint columns and take the join path unconditionally, and pin the
-    # pallas rank gather off (use_pallas=False): the pallas call must
-    # not trace under vmap.
+def _materialize_batched_safe(ops):
+    # default batched body: the hinted path's lax.cond would execute
+    # BOTH branches under vmap, so hints are dropped and the join runs;
+    # pallas stays off (must not trace under vmap)
     ops = {k: v for k, v in ops.items()
            if k not in ("parent_pos", "anchor_pos", "target_pos")}
-    return merge_mod._materialize.__wrapped__(ops, False)
+    return merge_mod._materialize.__wrapped__(ops, False, "join")
 
 
-_batched_kernel = jax.jit(jax.vmap(_materialize_join_only))
+def _materialize_batched_exhaustive(ops):
+    # opt-in fast body (batched_materialize(exhaustive_hints=True)):
+    # cond-free hinted resolution, valid ONLY for batches whose hint
+    # coverage the caller vouches for (pack/stack_packed provenance) —
+    # a violated promise silently mis-resolves references
+    return merge_mod._materialize.__wrapped__(ops, False, "exhaustive")
+
+
+_batched_kernel = jax.jit(jax.vmap(_materialize_batched_safe))
+_batched_kernel_hinted = jax.jit(jax.vmap(_materialize_batched_exhaustive))
 
 
 def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
-                        shard_ops_axis: bool = False) -> NodeTable:
+                        shard_ops_axis: bool = False,
+                        exhaustive_hints: bool = False) -> NodeTable:
     """B independent merges: arrays carry a leading document axis ``[B, N]``.
 
     The doc axis is sharded over ``docs`` — embarrassingly parallel, linear
     scaling (the serving path: many documents, one merge each).  With
     ``shard_ops_axis`` the op axis is additionally sharded over ``ops`` for
     2-D parallelism on large per-document batches.
+
+    ``exhaustive_hints=True`` opts into the cond-free hinted timestamp
+    resolution — ONLY for batches whose link-hint coverage the caller
+    vouches for (pack/stack_packed provenance); the default drops hints
+    and joins, which is correct for any input.
     """
     n_docs = mesh.shape[DOCS_AXIS]
     b = ops["kind"].shape[0]
@@ -144,10 +159,12 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
     def spec_for(v: np.ndarray) -> P:
         return P(DOCS_AXIS, *op_spec[:max(0, v.ndim - 1)])
 
+    kernel = _batched_kernel_hinted if exhaustive_hints else _batched_kernel
+
     def run():
         device_ops = {k: jax.device_put(v, NamedSharding(mesh, spec_for(v)))
                       for k, v in ops.items()}
-        return _batched_kernel(device_ops)
+        return kernel(device_ops)
 
     if jax.config.jax_enable_x64:
         return run()

@@ -212,6 +212,27 @@ def test_mislinked_hints_fall_back_to_join():
     assert view.visible_values(t, p.values) == want
 
 
+def test_hint_modes_agree():
+    """auto (cond fallback), exhaustive (no join compiled), and join
+    (hints ignored) must produce identical tables on pack-produced
+    batches — including one with a genuinely missing anchor (unresolved
+    ref: auto takes the join at runtime, exhaustive resolves to
+    not-found directly; same answer)."""
+    merged, ops = _random_session(33, n_replicas=3, steps=70)
+    ops = ops + [Add(77 * 2**32 + 1, (12345,), "orphan")]  # absent anchor
+    p = packed.pack(ops)
+    arrs = p.arrays()
+    tables = [view.to_host(merge.materialize(arrs, hints=h))
+              for h in (None, "exhaustive", "join")]
+    for t in tables[1:]:
+        assert view.visible_values(t, p.values) == \
+            view.visible_values(tables[0], p.values)
+        assert view.statuses(t, p.num_ops) == \
+            view.statuses(tables[0], p.num_ops)
+        assert np.array_equal(np.asarray(t.doc_index),
+                              np.asarray(tables[0].doc_index))
+
+
 def test_concat_reresolves_cross_hints():
     """concat must re-resolve each side's unresolved refs against the
     other side so the union's hints stay exhaustive (b's ops anchored in

@@ -54,6 +54,21 @@ def test_batched_docs_sharding(session_ops):
         assert view.visible_values(row, docs[d].values) == wants[d]
 
 
+def test_batched_exhaustive_hints_parity(session_ops):
+    """The opt-in cond-free hinted batched kernel must match the safe
+    (join) batched kernel bit for bit on pack-produced batches."""
+    _, ops = session_ops
+    docs = [packed.pack(ops[: 60 + 20 * d], capacity=256) for d in range(8)]
+    stacked = mesh_mod.stack_packed(docs)
+    m = mesh_mod.make_mesh(n_docs=8, n_ops=1)
+    safe = view.to_host(mesh_mod.batched_materialize(stacked, m))
+    fast = view.to_host(
+        mesh_mod.batched_materialize(stacked, m, exhaustive_hints=True))
+    for field in ("ts", "doc_index", "visible_order", "status"):
+        np.testing.assert_array_equal(getattr(fast, field),
+                                      getattr(safe, field), field)
+
+
 def test_2d_mesh_docs_by_ops(session_ops):
     want, ops = session_ops
     p = packed.pack(ops)
