@@ -103,8 +103,11 @@ def monotone_gather(values: jax.Array, rid: jax.Array,
     if use_pallas and os.environ.get("GRAFT_PALLAS_INTERPRET") == "1":
         interpret = True
     if use_pallas is None:
+        # GRAFT_NO_PALLAS=1 is the operational kill-switch (e.g. if the
+        # experimental backend's Mosaic lowering misbehaves mid-bench)
         use_pallas = HAVE_PALLAS and not interpret and \
-            jax.default_backend() == "tpu"
+            jax.default_backend() == "tpu" and \
+            os.environ.get("GRAFT_NO_PALLAS") != "1"
     # shape-derived exactness guard: token ids < T, run values < R;
     # weights are bounded by T as well (prefix sums of 0/1 weights)
     if not (use_pallas or interpret) or not HAVE_PALLAS or \
