@@ -456,6 +456,18 @@ def _materialize(ops: Dict[str, jax.Array],
     # us, so jumping to m's own candidate skips no answer of ours.  On
     # causal logs anchors are older than their nodes (smaller ts) and the
     # loop exits in 0 trips.
+    #
+    # The chase alone is NOT enough: a walker crossing territory of
+    # already-RESOLVED nodes advances one nearest-smaller step per trip
+    # (resolved pointers are frozen answers, not skip pointers), so an
+    # ascending anchor chain with a late smaller-ts op anchored at its
+    # tail needs O(chain) trips — the trip cap would silently truncate
+    # the walk and mis-parent the node (caught by the round-3 soak;
+    # regression: tests/test_merge_kernel.py ascending-chain case).
+    # Walkers still unresolved at the cap are finished EXACTLY by binary
+    # lifting over the raw anchor pointers (ancestor jumps + path-min
+    # tables, O(log^2) gathers) inside a lax.cond that causal and
+    # descending-chain logs never take.
     in_forest = valid & is_node_slot
     mptr0 = jnp.where(node_anchor_is_sentinel | ~in_forest, -1, aslot)
 
@@ -472,6 +484,33 @@ def _materialize(ops: Dict[str, jax.Array],
         return jnp.where(unresolved, mptr[m], mptr), i + 1
 
     mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
+    nsa_unresolved = (mptr >= 0) & (mptr > slot_ids)
+
+    def _nsa_lifting(mptr):
+        # up[k][v] = 2^k-th anchor ancestor (ROOT-absorbing; ROOT's slot
+        # 0 is smaller than every node, so it acts as the chain-exhausted
+        # stop); mn[k][v] = min slot among v's first 2^k proper ancestors
+        # — and since slots ARE the comparison keys, mn values are slots.
+        up0 = jnp.where(mptr0 >= 0, mptr0, ROOT).astype(jnp.int32)
+        up0 = up0.at[ROOT].set(ROOT)
+        ups = [up0]
+        mns = [up0]
+        k_levels = _ceil_log2(M)
+        for _ in range(1, k_levels):
+            pu, pm = ups[-1], mns[-1]
+            ups.append(pu[pu])
+            mns.append(jnp.minimum(pm, pm[pu]))
+        # descend: skip 2^k ancestors whenever none of them is smaller
+        cur = slot_ids
+        for k in reversed(range(k_levels)):
+            skip = nsa_unresolved & (mns[k][cur] >= slot_ids)
+            cur = jnp.where(skip, ups[k][cur], cur)
+        ans = up0[cur]          # first ancestor below the walker's slot
+        lifted = jnp.where(ans == ROOT, -1, ans)
+        return jnp.where(nsa_unresolved, lifted, mptr)
+
+    mptr = lax.cond(jnp.any(nsa_unresolved), _nsa_lifting,
+                    lambda m: m, mptr)
     star_parent = jnp.where(mptr >= 0, mptr, pslot)
     star_sentinel = mptr < 0
 

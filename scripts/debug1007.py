@@ -1,0 +1,34 @@
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from scripts.soak import random_session  # noqa: E402
+from crdt_graph_tpu.codec import packed  # noqa: E402
+from crdt_graph_tpu.ops import merge, view  # noqa: E402
+
+merged, ops, rng = random_session(1007)
+want = merged.visible_values()
+p = packed.pack(ops)
+for mode in (None, "exhaustive", "join"):
+    t = view.to_host(merge.materialize(p.arrays(), hints=mode))
+    got = view.visible_values(t, p.values)
+    tag = "match" if got == want else "MISMATCH"
+    print(mode, tag, len(got), len(want))
+    if got != want:
+        # where do they diverge?
+        for i, (g, w) in enumerate(zip(got, want)):
+            if g != w:
+                print("  first diff at", i, "got", g, "want", w)
+                break
+        if len(got) != len(want):
+            print("  lengths differ")
+        sg, sw = set(map(str, got)), set(map(str, want))
+        print("  value multisets equal:", sorted(map(str, got)) ==
+              sorted(map(str, want)))

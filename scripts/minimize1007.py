@@ -1,0 +1,65 @@
+"""Delta-debug the seed-1007 order mismatch to a minimal op list."""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import crdt_graph_tpu as crdt
+from scripts.soak import random_session
+from crdt_graph_tpu.codec import packed
+from crdt_graph_tpu.ops import merge, view
+
+
+def oracle_visible(ops):
+    t = crdt.init(99)
+    for op in ops:
+        try:
+            t = t.apply(op)
+        except crdt.CRDTError:
+            pass
+    return t.visible_values()
+
+
+def kernel_visible(ops):
+    p = packed.pack(ops)
+    t = view.to_host(merge.materialize(p.arrays()))
+    return view.visible_values(t, p.values)
+
+
+def mismatch(ops):
+    if not ops:
+        return False
+    return kernel_visible(ops) != oracle_visible(ops)
+
+
+merged, ops, _ = random_session(1007)
+assert mismatch(ops)
+rng = random.Random(0)
+
+cur = list(ops)
+# greedy single-removal passes until fixpoint
+changed = True
+while changed:
+    changed = False
+    i = 0
+    while i < len(cur):
+        cand = cur[:i] + cur[i + 1:]
+        if mismatch(cand):
+            cur = cand
+            changed = True
+        else:
+            i += 1
+    print(f"pass done: {len(cur)} ops", flush=True)
+
+print("MINIMAL:", len(cur))
+for op in cur:
+    print("  ", op)
+print("oracle:", oracle_visible(cur))
+print("kernel:", kernel_visible(cur))

@@ -1,0 +1,93 @@
+"""Differential soak: many randomized multi-replica sessions, each checked
+kernel-vs-oracle (visible sequence + statuses + permutation convergence +
+all three hint modes).  Run ad hoc: python scripts/soak.py [n_sessions]
+"""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+import crdt_graph_tpu as crdt
+from crdt_graph_tpu.codec import packed
+from crdt_graph_tpu.ops import merge, view
+
+
+def random_session(seed):
+    """Richer than the test-suite generator: varied replica counts,
+    delete rates, nesting rates, duplicate redelivery."""
+    rng = random.Random(seed)
+    n_replicas = rng.choice([2, 3, 5, 8])
+    steps = rng.choice([60, 150, 300])
+    p_branch = rng.choice([0.05, 0.2, 0.4])
+    p_delete = rng.choice([0.05, 0.2, 0.45])
+    trees = [crdt.init(r + 1) for r in range(n_replicas)]
+    for _ in range(steps):
+        i = rng.randrange(n_replicas)
+        t = trees[i]
+        roll = rng.random()
+        try:
+            if roll < p_delete:
+                vis = []
+                t.walk(lambda n, acc: ("take", acc.append(n.path) or acc),
+                       vis)
+                if vis:
+                    t = t.delete(rng.choice(vis))
+            elif roll < p_delete + p_branch:
+                t = t.add_branch(rng.randrange(1000))
+            elif roll < 0.85:
+                t = t.add(rng.randrange(1000))
+            else:
+                j = rng.randrange(n_replicas)
+                if j != i:
+                    t = t.apply(trees[j].operations_since(0))
+        except crdt.CRDTError:
+            pass
+        trees[i] = t
+    for i in range(n_replicas):
+        for j in range(n_replicas):
+            if i != j:
+                trees[i] = trees[i].apply(trees[j].operations_since(0))
+    from crdt_graph_tpu.core import operation as op_mod
+    ops = op_mod.to_list(trees[0].operations_since(0))
+    return trees[0], ops, rng
+
+
+def check(seed):
+    merged, ops, rng = random_session(seed)
+    want = merged.visible_values()
+    p = packed.pack(ops)
+    for mode in (None, "exhaustive", "join"):
+        t = view.to_host(merge.materialize(p.arrays(), hints=mode))
+        got = view.visible_values(t, p.values)
+        assert got == want, (seed, mode, "visible mismatch")
+    # shuffled delivery incl. a duplicated slice
+    perm = ops[:] + ops[: len(ops) // 3]
+    rng.shuffle(perm)
+    p2 = packed.pack(perm)
+    t2 = view.to_host(merge.materialize(p2.arrays()))
+    assert view.visible_values(t2, p2.values) == want, (seed, "perm+dup")
+    return len(ops)
+
+
+def main(n):
+    total = 0
+    for k in range(n):
+        total += check(1000 + k)
+        if (k + 1) % 10 == 0:
+            print(f"soak: {k + 1}/{n} sessions ok ({total} ops total)",
+                  flush=True)
+    print(f"SOAK OK: {n} sessions, {total} ops")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 60)

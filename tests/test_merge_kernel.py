@@ -154,6 +154,29 @@ def test_tombstone_anchor_still_orders():
     assert vis == ["a", "c"]
 
 
+def test_long_ascending_chain_with_late_small_anchor():
+    """Regression (round-3 soak): an ASCENDING anchor chain resolves each
+    node's nearest-smaller-ancestor instantly (frozen answers), and a
+    late smaller-timestamp op anchored at the chain tail must then walk
+    that answer chain — longer than the chase's log-trip cap.  The
+    binary-lifting fallback finishes the walk exactly; without it the
+    node mis-parents and the visible order flips its last two entries."""
+    R = 2 * 2**32
+    chain_len = 40                       # > ceil(log2(M)) + 2 trips
+    ops = [Add(R + 1, (0,), "A"), Add(R + 2, (R + 1,), "X")]
+    prev = R + 1
+    for k in range(3, chain_len + 3):
+        ops.append(Add(R + k, (prev,), f"c{k}"))
+        prev = R + k
+    # replica 1: smaller ts than every chain node, anchored at the tail
+    ops.append(Add(1 * 2**32 + 1, (prev,), "Z"))
+    vis, _, _ = kernel_visible(ops)
+    want, _ = oracle_visible(ops)
+    assert vis == want
+    # the reference order: Z drifts right past X (larger ts) to the end
+    assert vis[-2:] == ["X", "Z"]
+
+
 # -- permutation invariance on fixed fixtures -----------------------------
 
 def test_permutation_invariance_small():
