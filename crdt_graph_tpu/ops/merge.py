@@ -420,7 +420,38 @@ def _materialize(ops: Dict[str, jax.Array],
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
     cascade_ok = _fix_and(local_ok | ~is_node_slot, order_parent,
                           _ceil_log2(M) + 1)
-    valid = cascade_ok & is_node_slot
+
+    # ---- 6b. Anchor-CYCLE rejection.  An adversarial op set can close a
+    # loop of same-branch anchors (a anchored at b, b at a): every member
+    # is locally ok and the AND-cascade over the cycle stays true, yet no
+    # serial application order admits any member — the reference rejects
+    # them all (each one's anchor is absent when it arrives).  A cycle
+    # must contain an edge whose anchor has a LARGER slot, so causal logs
+    # (and the sentinel-anchored combs) skip this entirely; when such an
+    # edge exists, full pointer-squaring reachability flags every node
+    # whose chain never reaches a terminal (ROOT/NULL).  Parent edges
+    # cannot cycle (depth strictly decreases), so order_parent covers
+    # the whole graph.
+    # >= : a SELF-anchored op (anchor ts == own ts) is a 1-cycle and must
+    # route through the reachability check too (its self-loop is not a
+    # terminal, so it gets flagged like longer loops)
+    up_edge = jnp.any(is_node_slot & ~node_anchor_is_sentinel &
+                      (aslot != NULL) & (aslot >= slot_ids))
+
+    def _reaches_terminal(ptr):
+        k_cap = _ceil_log2(M) + 1
+
+        def body(state):
+            p, i = state
+            return p[p], i + 1
+
+        p, _ = lax.while_loop(lambda s: s[1] < k_cap, body,
+                              (ptr, jnp.int32(0)))
+        return (p == ROOT) | (p == NULL)
+
+    acyclic = lax.cond(up_edge, _reaches_terminal,
+                       lambda p: jnp.ones(M, bool), order_parent)
+    valid = cascade_ok & acyclic & is_node_slot
     valid = valid.at[ROOT].set(True)
     # canonical parent pointer for existing nodes; root for itself
     parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)

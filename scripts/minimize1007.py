@@ -1,6 +1,5 @@
 """Delta-debug the seed-1007 order mismatch to a minimal op list."""
 import os
-import random
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -12,7 +11,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import crdt_graph_tpu as crdt
-from scripts.soak import random_session
+from scripts.soak import random_session  # shared session generator
 from crdt_graph_tpu.codec import packed
 from crdt_graph_tpu.ops import merge, view
 
@@ -41,7 +40,6 @@ def mismatch(ops):
 
 merged, ops, _ = random_session(1007)
 assert mismatch(ops)
-rng = random.Random(0)
 
 cur = list(ops)
 # greedy single-removal passes until fixpoint

@@ -10,12 +10,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
 import crdt_graph_tpu as crdt
 from crdt_graph_tpu.codec import packed

@@ -154,6 +154,29 @@ def test_tombstone_anchor_still_orders():
     assert vis == ["a", "c"]
 
 
+@pytest.mark.parametrize("cycle", [
+    # 1-cycle: an op anchored at its own timestamp
+    [Add(5, (5,), "a")],
+    # 2-cycle: each op anchors at the other
+    [Add(5, (7,), "a"), Add(7, (5,), "b")],
+    # 3-cycle
+    [Add(5, (9,), "a"), Add(7, (5,), "b"), Add(9, (7,), "c")],
+])
+def test_anchor_cycles_rejected_like_the_oracle(cycle):
+    """An adversarial op set closing an anchor loop admits NO serial
+    order — the oracle rejects every member (anchor absent on arrival),
+    and the kernel's cycle check must agree instead of letting the loop
+    corrupt the order forest.  Surrounding valid ops are unaffected."""
+    ops = [Add(1, (0,), "x")] + cycle + [Add(2, (1,), "y")]
+    want, _ = oracle_visible(ops)
+    vis, t, p = kernel_visible(ops)
+    assert want == ["x", "y"]
+    assert vis == want
+    st = view.statuses(t, p.num_ops)
+    assert st[0] == "applied" and st[-1] == "applied"
+    assert all(s in ("not_found", "invalid_path") for s in st[1:-1])
+
+
 def test_long_ascending_chain_with_late_small_anchor():
     """Regression (round-3 soak): an ASCENDING anchor chain resolves each
     node's nearest-smaller-ancestor instantly (frozen answers), and a
