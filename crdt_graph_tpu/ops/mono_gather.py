@@ -26,8 +26,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-TILE = 512       # tokens per grid step
-SPAN = TILE + 128  # values rows DMA'd per tile (≥ TILE+1; 128-lane pad)
+TILE = 1024      # tokens per grid step (matches XLA's s32[N] T(1024) layout)
+SPAN = TILE + 128  # values rows DMA'd per tile (≥ TILE+128: aligned starts)
 
 try:  # pallas is TPU/Mosaic; keep importable on bare CPU builds
     from jax.experimental import pallas as pl
@@ -47,41 +47,54 @@ def _lax_gather(values: jax.Array, rid: jax.Array) -> jax.Array:
 if HAVE_PALLAS:
     def _kernel(starts_ref, rid_ref, vals_hbm, out_ref, scratch, sem):
         i = pl.program_id(0)
-        r0 = starts_ref[i]
+        # starts arrive pre-divided by 128: multiplying back inside the
+        # kernel lets Mosaic PROVE the dynamic DMA offset is 128-aligned
+        # (an opaque prefetched scalar fails that proof)
+        r0 = starts_ref[i] * 128
         copy = pltpu.make_async_copy(
             vals_hbm.at[:, pl.ds(r0, SPAN)], scratch, sem)
         copy.start()
         copy.wait()
-        # off[t] = rid[t] - r0 ∈ [0, TILE]; one-hot over the SPAN axis
-        off = rid_ref[0, :] - r0
+        # off[t] = rid[t] - r0 ∈ [0, TILE+127] (starts floor to a lane
+        # tile), which is why SPAN must be ≥ TILE+128; one-hot over SPAN
+        off = rid_ref[...] - r0
         onehot = (off[:, None] == jax.lax.broadcasted_iota(
             jnp.int32, (TILE, SPAN), 1)).astype(jnp.float32)
         vals_f = scratch[...].astype(jnp.float32)          # [V, SPAN]
+        # HIGHEST: the MXU's default bf16 passes truncate >2^8-magnitude
+        # ints (caught live: 91158 read back as 91136); full-f32 passes
+        # keep every product/sum exact below 2^24
         out = jax.lax.dot_general(
             vals_f, onehot, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [V, TILE]
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)           # [V, TILE]
         out_ref[...] = out.astype(jnp.int32)
 
-    def _pallas_call(vals_pad, rid2d, starts, v, tiles, interpret):
+    def _pallas_call(vals_pad, rid_pad, starts, v8, tiles, interpret):
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(tiles,),
             in_specs=[
-                pl.BlockSpec((1, TILE), lambda i, starts: (i, 0)),
+                # rid rides 1-D: a (TILE,) block keeps the lane dim at a
+                # multiple of 128 and matches XLA's s32[N] T(1024) layout
+                # (Mosaic requires last-two block dims ≡ 0 mod (8, 128) or
+                # full — a (1, TILE) block over [tiles, TILE] fails on
+                # real TPU lowering; caught on first live-chip run)
+                pl.BlockSpec((TILE,), lambda i, starts: (i,)),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec((v, TILE), lambda i, starts: (0, i)),
+            out_specs=pl.BlockSpec((v8, TILE), lambda i, starts: (0, i)),
             scratch_shapes=[
-                pltpu.VMEM((v, SPAN), jnp.int32),
+                pltpu.VMEM((v8, SPAN), jnp.int32),
                 pltpu.SemaphoreType.DMA,
             ],
         )
         return pl.pallas_call(
             _kernel,
-            out_shape=jax.ShapeDtypeStruct((v, tiles * TILE), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((v8, tiles * TILE), jnp.int32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(starts, rid2d, vals_pad)
+        )(starts, rid_pad, vals_pad)
 
 
 def monotone_gather(values: jax.Array, rid: jax.Array,
@@ -117,8 +130,17 @@ def monotone_gather(values: jax.Array, rid: jax.Array,
     tiles = -(-t // TILE)
     t_pad = tiles * TILE
     rid_pad = jnp.pad(rid.astype(jnp.int32), (0, t_pad - t), mode="edge")
-    vals_pad = jnp.pad(values.astype(jnp.int32), ((0, 0), (0, SPAN)))
-    starts = rid_pad[::TILE]
-    rid2d = rid_pad.reshape(tiles, TILE)
-    out = _pallas_call(vals_pad, rid2d, starts, v, tiles, interpret)
-    return out[:, :t]
+    # DMA slices must be 8-aligned in the sublane dim: pad V up to 8
+    v8 = -(-v // 8) * 8
+    vals_pad = jnp.pad(values.astype(jnp.int32), ((0, v8 - v), (0, SPAN)))
+    # Mosaic requires the dynamic lane-dim DMA offset to be 128-aligned:
+    # each tile's start rounds down to a lane tile (the kernel multiplies
+    # back); off ∈ [0, TILE+127] still < SPAN
+    starts = rid_pad[::TILE] // 128
+    # every operand is explicit i32; tracing the pallas_call itself under
+    # x64 emits index/grid ops Mosaic cannot legalize ('func.func'), so
+    # scope it to x32 — caller dtypes are unaffected (no-op when x64 is
+    # already off)
+    with jax.enable_x64(False):
+        out = _pallas_call(vals_pad, rid_pad, starts, v8, tiles, interpret)
+    return out[:v, :t]
