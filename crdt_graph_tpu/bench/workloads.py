@@ -20,6 +20,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from ..codec.packed import compute_ts_rank
 from ..core.operation import Add, Delete, Operation
 
 OFFSET = 2**32
@@ -27,6 +28,13 @@ OFFSET = 2**32
 
 def _ts(rid: int, counter: int) -> int:
     return rid * OFFSET + counter
+
+
+def _with_rank(arrs):
+    """Attach the ingest rank hint (codec.packed docstring) to a raw
+    array workload, as every PackedOps producer does."""
+    arrs["ts_rank"] = compute_ts_rank(arrs["kind"], arrs["ts"])
+    return arrs
 
 
 def editor_replay(n_ops: int = 1000, seed: int = 0,
@@ -153,7 +161,7 @@ def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
     paths = np.zeros((n, max_depth), dtype=np.int64)
     paths[:, 0] = anchor
     idx = np.arange(n, dtype=np.int32)
-    return {
+    return _with_rank({
         "kind": np.zeros(n, dtype=np.int8),           # all adds
         "ts": ts,
         "parent_ts": np.zeros(n, dtype=np.int64),
@@ -166,7 +174,7 @@ def chain_workload(n_replicas: int = 64, n_ops: int = 1_000_000,
         "parent_pos": np.full(n, -1, dtype=np.int32),
         "anchor_pos": np.where(counter == 1, -1, idx - 1).astype(np.int32),
         "target_pos": np.full(n, -1, dtype=np.int32),
-    }
+    })
 
 
 def chain_expected_ts(n_replicas: int = 64,
@@ -216,7 +224,7 @@ def descending_chains(n_replicas: int = 4096,
     paths = np.zeros((n, max_depth), dtype=np.int64)
     paths[:, 0] = anchor
     idx = np.arange(n, dtype=np.int32)
-    return {
+    return _with_rank({
         "kind": np.zeros(n, dtype=np.int8),
         "ts": ts,
         "parent_ts": np.zeros(n, dtype=np.int64),
@@ -228,7 +236,7 @@ def descending_chains(n_replicas: int = 4096,
         "parent_pos": np.full(n, -1, dtype=np.int32),
         "anchor_pos": np.where(round_head, -1, idx - 1).astype(np.int32),
         "target_pos": np.full(n, -1, dtype=np.int32),
-    }
+    })
 
 
 def comb_pairs(n_ops: int = 1_000_000,
@@ -261,7 +269,7 @@ def comb_pairs(n_ops: int = 1_000_000,
     idx = np.arange(n, dtype=np.int32)
     parent_pos = np.full(n, -1, dtype=np.int32)
     parent_pos[1::2] = idx[0::2]
-    return {
+    return _with_rank({
         "kind": np.zeros(n, dtype=np.int8),
         "ts": ts,
         "parent_ts": parent_ts,
@@ -273,7 +281,7 @@ def comb_pairs(n_ops: int = 1_000_000,
         "parent_pos": parent_pos,
         "anchor_pos": np.full(n, -1, dtype=np.int32),
         "target_pos": np.full(n, -1, dtype=np.int32),
-    }
+    })
 
 
 def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
@@ -327,7 +335,7 @@ def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
     parent_pos[base] = n_skel - 1                 # deepest branch node
     anchor_pos = np.full(n, -1, dtype=np.int32)
     anchor_pos[base] = np.where(first, -1, idx[base] - 1)
-    return {
+    return _with_rank({
         "kind": kind,
         "ts": ts,
         "parent_ts": parent_ts,
@@ -339,7 +347,7 @@ def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
         "parent_pos": parent_pos,
         "anchor_pos": anchor_pos,
         "target_pos": np.full(n, -1, dtype=np.int32),
-    }
+    })
 
 
 def descending_expected_ts(n_replicas: int = 4096,

@@ -26,6 +26,11 @@ Layout (N = padded op count, D = maximum path length):
 - ``anchor_pos`` i32[N]  — adds: batch position of the anchor's Add
                            (-1 = sentinel / not in batch)
 - ``target_pos`` i32[N]  — deletes: batch position of the target's Add
+- ``ts_rank``    i32[N]  — adds: rank of this op's timestamp among the
+                           batch's UNIQUE add timestamps, ascending
+                           (-1 = non-add / unranked); RANK HINT — lets
+                           the kernel assign timestamp-ordered slots
+                           without its full-width device sort
 
 Timestamps are int64: ``replica_id * 2**32 + counter`` exceeds int32 by
 design (core/timestamp.py).  Shapes are padded to buckets (powers of two) so
@@ -41,6 +46,17 @@ verifies ``ts[hint] == referenced_ts`` on device and falls back to the
 full sort-join if ANY hint fails to verify, so a wrong or missing hint
 can never change semantics, only speed.  ``-1`` means "not resolved";
 raw-array callers that provide no hint columns at all get the join path.
+
+**Rank hints.**  Same economics, applied to the kernel's OTHER use of
+the timestamp sort: assigning each unique add a dense slot id whose
+order is timestamp order.  ``ts_rank`` carries that rank from ingest
+(one vectorized ``np.unique`` here), so the kernel can scatter ops
+straight into their slots and skip its full-width device sort — its
+single most expensive stage on v5e.  Advisory like link hints: the
+kernel re-derives the invariants on device (dense used-slot prefix,
+strictly increasing slot timestamps, every add ranked, duplicate
+timestamps agreeing) and any violation sends the whole batch down the
+sort path, so wrong ranks cost speed, never correctness.
 """
 from __future__ import annotations
 
@@ -83,6 +99,8 @@ class PackedOps:
     parent_pos: Optional[np.ndarray] = None
     anchor_pos: Optional[np.ndarray] = None
     target_pos: Optional[np.ndarray] = None
+    # rank hint (see module docstring); default -1 = device-sort fallback
+    ts_rank: Optional[np.ndarray] = None
     # host-side ts -> first add position index, cached so engine concat
     # chains don't rebuild it per bulk apply (not a device field)
     ts_index: Optional[dict] = dataclasses.field(default=None, repr=False)
@@ -95,6 +113,8 @@ class PackedOps:
             self.anchor_pos = np.full(cap, -1, dtype=np.int32)
         if self.target_pos is None:
             self.target_pos = np.full(cap, -1, dtype=np.int32)
+        if self.ts_rank is None:
+            self.ts_rank = compute_ts_rank(self.kind, self.ts)
 
     @property
     def capacity(self) -> int:
@@ -111,7 +131,7 @@ class PackedOps:
             "anchor_ts": self.anchor_ts, "depth": self.depth,
             "paths": self.paths, "value_ref": self.value_ref, "pos": self.pos,
             "parent_pos": self.parent_pos, "anchor_pos": self.anchor_pos,
-            "target_pos": self.target_pos,
+            "target_pos": self.target_pos, "ts_rank": self.ts_rank,
         }
 
     def index(self) -> dict:
@@ -128,6 +148,19 @@ class PackedOps:
             self.ts_index = dict(zip(uniq.tolist(),
                                      add_pos[first_idx].tolist()))
         return self.ts_index
+
+
+def compute_ts_rank(kind: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    """Rank of each add's timestamp among the batch's unique add
+    timestamps, ascending; -1 for non-add rows.  One vectorized
+    ``np.unique`` — the host-side cost that buys the kernel out of its
+    full-width device sort (see module docstring, rank hints)."""
+    rank = np.full(kind.shape[0], -1, dtype=np.int32)
+    add_rows = np.nonzero((kind == KIND_ADD) & (ts > 0))[0]
+    if add_rows.size:
+        _, inv = np.unique(ts[add_rows], return_inverse=True)
+        rank[add_rows] = inv.astype(np.int32)
+    return rank
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -321,6 +354,8 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     out.ts_index = dict(a_index)
     for t, i in b_index.items():
         out.ts_index.setdefault(t, i + na)
+    # rank hints cover the union (post_init saw only padding rows)
+    out.ts_rank = compute_ts_rank(out.kind, out.ts)
     return out
 
 

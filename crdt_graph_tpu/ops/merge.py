@@ -250,87 +250,89 @@ def _materialize(ops: Dict[str, jax.Array],
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
 
-    # ---- 1. Sort adds by timestamp as (hi, lo) int32 key pairs; the sort
-    # is stable, so among duplicate timestamps the FIRST ROW IN THE ARRAY
-    # wins (idempotence, Internal/Node.elm:63-65) — producers keep
-    # ``pos == array index`` (codec/packed.py) so this equals
-    # first-arrival order.  Non-adds sink to the end.  This is the only
-    # timestamp-keyed sort; after it, slot ids are dense int32 ranks
-    # whose order IS timestamp order.
-    sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
-    ts_hi, ts_lo = _split_ts(sort_ts)
-    # lax.sort is stable, so equal timestamps keep batch order and the
-    # pos column needs no key slot; it is re-derived by one gather —
-    # cheaper than carrying a fourth array through the sort network
-    s_hi, s_lo, sorted_idx = lax.sort(
-        (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
-    sorted_pos = pos[sorted_idx]
-    sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
-        (s_lo.astype(jnp.int64) + 2**31)
-    run_start = jnp.concatenate(
-        [jnp.ones(1, bool),
-         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
-    not_big = s_hi < (BIG >> 32)
-    is_canon = run_start & not_big
-    # slot of the run's canonical add = run-start index + 1
-    canon_pos = lax.cummax(jnp.where(run_start,
-                                     jnp.arange(N, dtype=jnp.int32), 0))
-    slot_of_sorted = canon_pos + 1
-    # per-op: node slot and duplicate flag (original batch order).
-    # sorted_idx is a permutation — declare indices unique so XLA's TPU
-    # scatter takes the parallel path instead of the serialized
-    # duplicate-safe one (a top cost of the round-2 kernel).
-    op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
-        jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
-    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
-        ~run_start & not_big, unique_indices=True)
-
     # ---- 2. Column index row, shared by the masked path compares below.
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
 
-    # ---- 3. Scatter canonical adds into the node table (slots 1..N).
-    # Non-canonical rows aim out of range (M) and are dropped, leaving the
-    # in-range indices unique — again the parallel scatter path.
-    tgt = jnp.where(is_canon, slot_of_sorted, M)
-
-    def scat(init, vals, at=tgt):
-        return init.at[at].set(vals, mode="drop", unique_indices=True)
-
-    g = lambda a: a[sorted_idx]  # noqa: E731  original-order field, sorted
-    node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
-        .at[NULL].set(BIG)
-    node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
-    node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
-    node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
-    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
-        paths[sorted_idx], mode="drop", unique_indices=True)
-    is_node_slot = scat(jnp.zeros(M, bool), is_canon)
-
-    # Full materialised path: claimed anchor path with the node's own ts in
-    # the last position (Internal/Node.elm:79-82).
-    col = jnp.clip(node_depth - 1, 0, D - 1)
-    fp = node_claimed.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
-        unique_indices=True)
-
-    # ---- 4. Timestamp → slot resolution.  Two interchangeable paths:
+    # ---- 1-4. Slot assignment and timestamp→slot resolution.  Three
+    # interchangeable constructions of one interface (the 17-tuple below);
+    # all downstream stages are path-agnostic.
     #
-    # JOIN: one sort-merge join of all 2M+2N queries against the sorted
-    # add axis (method="sort": the default per-query binary search was
-    # 1.67 s device time at 1M ops on v5e; the join is ~20x cheaper).
+    # SORTED+JOIN (always available): one stable (hi, lo) int32 key sort
+    # of the add timestamps assigns dense slots (slot order IS timestamp
+    # order; first array row wins duplicates — producers keep ``pos ==
+    # array index``, codec/packed.py), then a sort-merge join resolves all
+    # 2M+2N timestamp references (method="sort": the per-query binary
+    # search was 1.67 s device time at 1M ops on v5e).
     #
-    # HINTED: when the ingest provided link-hint columns (codec.packed:
-    # batch POSITION of each referenced add), each reference is one
-    # verified int32 gather — ts[hint] must equal the referenced
-    # timestamp, checked on device.  In the default/auto mode, if ANY
-    # nonzero reference lacks a verified hint (hint-less producer,
-    # stale/mislinked hint, or a genuinely absent target), lax.cond
-    # falls back to the full join for the whole batch — hints stay
-    # advisory there.  In "exhaustive" mode the caller VOUCHES for hint
-    # coverage (pack/concat-produced batches) and the join never
-    # compiles — a violated promise there silently mis-resolves
-    # references, which is why the mode is opt-in per call site.
-    def _resolve_joined(_):
+    # RANKED+HINTED (ingest hints): ``ts_rank`` assigns slots directly
+    # (slot = rank+1, canonical copy = min batch pos per slot, one
+    # scatter-min) and link-hint columns resolve each reference with one
+    # verified int32 gather — no sort, no join: the full-width device
+    # sort was the kernel's single most expensive stage on v5e.  In auto
+    # mode the ranks are VERIFIED on device (dense used-slot prefix,
+    # strictly increasing slot timestamps, every add ranked, duplicates
+    # agreeing — these four properties hold iff the ranks are exactly the
+    # unique-timestamp ranks) and the link hints are verified per
+    # reference (``ts[hint] == referenced_ts``); ANY violation sends the
+    # whole batch down the sorted+join branch via lax.cond, so wrong
+    # hints cost speed, never correctness.  In "exhaustive" mode the
+    # caller VOUCHES for hint coverage (pack/concat provenance) and the
+    # sort/join never compile — a violated promise there silently
+    # mis-resolves, which is why the mode is opt-in per call site.
+    def _sorted_core():
+        """Steps 1+3, sort-based: the 9 table arrays plus what the join
+        needs (sorted_ts and the canonical scatter)."""
+        sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
+        ts_hi, ts_lo = _split_ts(sort_ts)
+        # stable sort: equal timestamps keep batch order; pos re-derives
+        # by one gather — cheaper than a fourth array through the network
+        s_hi, s_lo, sorted_idx = lax.sort(
+            (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+        sorted_pos = pos[sorted_idx]
+        sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
+            (s_lo.astype(jnp.int64) + 2**31)
+        run_start = jnp.concatenate(
+            [jnp.ones(1, bool),
+             (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+        not_big = s_hi < (BIG >> 32)
+        is_canon = run_start & not_big
+        # slot of the run's canonical add = run-start index + 1
+        canon_pos = lax.cummax(jnp.where(run_start,
+                                         jnp.arange(N, dtype=jnp.int32), 0))
+        slot_of_sorted = canon_pos + 1
+        # per-op slot + duplicate flag (original batch order).  sorted_idx
+        # is a permutation — unique indices keep XLA's TPU scatter on the
+        # parallel path instead of the serialized duplicate-safe one.
+        op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
+            jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
+        op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
+            ~run_start & not_big, unique_indices=True)
+
+        # Scatter canonical adds into the node table (slots 1..N).
+        # Non-canonical rows aim out of range (M) and drop.
+        tgt = jnp.where(is_canon, slot_of_sorted, M)
+
+        def scat(init, vals):
+            return init.at[tgt].set(vals, mode="drop", unique_indices=True)
+
+        g = lambda a: a[sorted_idx]  # noqa: E731  original-order, sorted
+        node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts) \
+            .at[ROOT].set(0).at[NULL].set(BIG)
+        node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
+        node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
+        node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
+        node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
+            paths[sorted_idx], mode="drop", unique_indices=True)
+        is_node_slot = scat(jnp.zeros(M, bool), is_canon)
+        node_anchor_sent = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
+        tables = (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
+                  node_pos, node_claimed, is_node_slot, node_anchor_sent)
+        return tables, sorted_ts, scat, g
+
+    def _joined_from(core):
+        """Sort-merge join of all 2M+2N timestamp references against the
+        sorted add axis (closes over the core's sorted_ts/scatter)."""
+        _, sorted_ts, scat, g = core
         queries = jnp.concatenate([
             scat(jnp.zeros(M, jnp.int64), g(parent_ts)),   # node parent ts
             scat(jnp.zeros(M, jnp.int64), g(anchor_ts)),   # node anchor ts
@@ -343,45 +345,137 @@ def _materialize(ops: Dict[str, jax.Array],
         qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & \
             (queries < BIG)
         qslot = jnp.where(queries == 0, ROOT,
-                          jnp.where(qhit, qidx_c + 1, NULL))
+                          jnp.where(qhit, qidx_c + 1, NULL)) \
+            .astype(jnp.int32)
         qfound = (queries == 0) | qhit
         return (qslot[:M], qslot[M:2 * M],
                 qslot[2 * M:2 * M + N], qslot[2 * M + N:],
                 qfound[:M], qfound[M:2 * M],
                 qfound[2 * M:2 * M + N], qfound[2 * M + N:])
 
-    have_hints = hints != "join" and all(
-        k in ops for k in ("parent_pos", "anchor_pos", "target_pos"))
-    if have_hints:
-        def _res(hint, want):
-            p = jnp.clip(hint, 0, N - 1)
-            ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
-                (want > 0) & (want < BIG)
-            slot = jnp.where(want == 0, ROOT,
-                             jnp.where(ok, op_slot[p], NULL))
-            # auto mode: any nonzero reference WITHOUT a verified hint
-            # (missing, stale, or mislinked — e.g. a hint-less producer)
-            # sends the whole batch through the join; exhaustive mode
-            # skips that net by the caller's coverage promise
-            miss = (want > 0) & (want < BIG) & ~ok
-            return slot.astype(jnp.int32), (want == 0) | ok, miss
+    def _build_sorted_joined(_):
+        core = _sorted_core()
+        return core[0] + _joined_from(core)
 
-        pp_slot, pp_found, pp_miss = _res(
-            ops["parent_pos"].astype(jnp.int32), parent_ts)
-        aa_slot, aa_found, aa_miss = _res(
-            ops["anchor_pos"].astype(jnp.int32), anchor_ts)
-        tt_slot, tt_found, tt_miss = _res(
-            ops["target_pos"].astype(jnp.int32), ts)
-        hinted = (scat(jnp.full(M, NULL, jnp.int32), g(pp_slot)),
-                  scat(jnp.full(M, NULL, jnp.int32), g(aa_slot)),
+    def _res_hint(hint, want, op_slot_arr):
+        """One link-hint resolution: verified int32 gather (see the
+        RANKED+HINTED contract above).  ``miss`` flags any nonzero
+        reference without a verified hint."""
+        p = jnp.clip(hint, 0, N - 1)
+        ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
+            (want > 0) & (want < BIG)
+        slot = jnp.where(want == 0, ROOT,
+                         jnp.where(ok, op_slot_arr[p], NULL))
+        miss = (want > 0) & (want < BIG) & ~ok
+        return slot.astype(jnp.int32), (want == 0) | ok, miss
+
+    def _resolve_hinted(op_slot_arr):
+        pp = _res_hint(ops["parent_pos"].astype(jnp.int32), parent_ts,
+                       op_slot_arr)
+        aa = _res_hint(ops["anchor_pos"].astype(jnp.int32), anchor_ts,
+                       op_slot_arr)
+        tt = _res_hint(ops["target_pos"].astype(jnp.int32), ts,
+                       op_slot_arr)
+        return pp, aa, tt
+
+    have_link = hints != "join" and all(
+        k in ops for k in ("parent_pos", "anchor_pos", "target_pos"))
+    have_rank = have_link and "ts_rank" in ops
+
+    if have_rank:
+        rank = ops["ts_rank"].astype(jnp.int32)
+        is_real_add = is_add & (ts > 0) & (ts < BIG)
+        has_rank = is_real_add & (rank >= 0) & (rank < N)
+        op_slot_r = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
+        # canonical copy = min batch pos per slot (pos is the row index,
+        # so this is first-arrival, matching the stable sort)
+        win = jnp.full(M, IPOS, jnp.int32).at[
+            jnp.where(has_rank, op_slot_r, M)].min(pos, mode="drop")
+        is_canon_op = has_rank & (pos == win[op_slot_r])
+        op_is_dup_r = has_rank & ~is_canon_op
+        # exactly one canonical per used slot (pos values are unique), so
+        # these scatters are parallel-path even under hostile ranks
+        tgt_op = jnp.where(is_canon_op, op_slot_r, M)
+
+        def scat_op(init, vals):
+            return init.at[tgt_op].set(vals, mode="drop",
+                                       unique_indices=True)
+
+        node_ts_r = scat_op(jnp.full(M, BIG, jnp.int64), ts) \
+            .at[ROOT].set(0).at[NULL].set(BIG)
+        node_depth_r = scat_op(jnp.zeros(M, jnp.int32), depth) \
+            .at[ROOT].set(0)
+        node_value_ref_r = scat_op(jnp.full(M, -1, jnp.int32), value_ref)
+        node_pos_r = win
+        node_claimed_r = jnp.zeros((M, D), jnp.int64).at[tgt_op].set(
+            paths, mode="drop", unique_indices=True)
+        is_node_slot_r = scat_op(jnp.zeros(M, bool), jnp.ones(N, bool))
+        node_anchor_sent_r = scat_op(jnp.zeros(M, bool), anchor_ts == 0)
+
+        ((pp_slot, pp_found, pp_miss),
+         (aa_slot, aa_found, aa_miss),
+         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot_r)
+        ranked = (op_slot_r, op_is_dup_r, node_ts_r, node_depth_r,
+                  node_value_ref_r, node_pos_r, node_claimed_r,
+                  is_node_slot_r, node_anchor_sent_r,
+                  scat_op(jnp.full(M, NULL, jnp.int32), pp_slot),
+                  scat_op(jnp.full(M, NULL, jnp.int32), aa_slot),
                   tt_slot, pp_slot,
-                  scat(jnp.zeros(M, bool), g(pp_found)),
-                  scat(jnp.zeros(M, bool), g(aa_found)),
+                  scat_op(jnp.zeros(M, bool), pp_found),
+                  scat_op(jnp.zeros(M, bool), aa_found),
                   tt_found, pp_found)
         if hints == "exhaustive":
-            # producer guarantees every in-batch reference is hinted, so
-            # unresolved == genuinely absent and the hinted results ARE
-            # the answer — no cond, no join in the program at all
+            (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
+             node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
+             pslot, aslot, d_tslot, dp_slot,
+             pfound, afound, d_tfound, dp_found) = ranked
+        else:
+            # rank verification: the four properties below hold iff
+            # ts_rank is exactly the unique-add-timestamp rank
+            used = is_node_slot_r
+            nts = node_ts_r
+            dense_ok = jnp.all(~used[2:M - 1] | used[1:M - 2])
+            incr_ok = jnp.all(jnp.where(used[1:M - 1] & used[2:M],
+                                        nts[1:M - 1] < nts[2:M], True))
+            ts_match = jnp.all(
+                jnp.where(has_rank, nts[jnp.clip(op_slot_r, 0, M - 1)]
+                          == ts, True))
+            all_ranked = jnp.all(~is_real_add | has_rank)
+            link_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
+                jnp.any(tt_miss & is_del)
+            hints_ok = dense_ok & incr_ok & ts_match & all_ranked & \
+                ~link_miss
+            (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
+             node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
+             pslot, aslot, d_tslot, dp_slot,
+             pfound, afound, d_tfound, dp_found) = lax.cond(
+                hints_ok, lambda _: ranked, _build_sorted_joined, None)
+    elif have_link:
+        # link hints without ranks: sorted slot assignment runs eagerly,
+        # hinted resolution with per-reference verification; the JOIN
+        # stays inside the cond fallback so verified-hint merges never
+        # execute it
+        core = _sorted_core()
+        (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
+         node_pos, node_claimed, is_node_slot,
+         node_anchor_is_sentinel) = core[0]
+
+        ((pp_slot, pp_found, pp_miss),
+         (aa_slot, aa_found, aa_miss),
+         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot)
+        canon_tgt = jnp.where(~op_is_dup & (op_slot != NULL), op_slot, M)
+
+        def scat_c(init, vals):
+            return init.at[canon_tgt].set(vals, mode="drop",
+                                          unique_indices=True)
+
+        hinted = (scat_c(jnp.full(M, NULL, jnp.int32), pp_slot),
+                  scat_c(jnp.full(M, NULL, jnp.int32), aa_slot),
+                  tt_slot, pp_slot,
+                  scat_c(jnp.zeros(M, bool), pp_found),
+                  scat_c(jnp.zeros(M, bool), aa_found),
+                  tt_found, pp_found)
+        if hints == "exhaustive":
             (pslot, aslot, d_tslot, dp_slot,
              pfound, afound, d_tfound, dp_found) = hinted
         else:
@@ -389,12 +483,21 @@ def _materialize(ops: Dict[str, jax.Array],
                 jnp.any(tt_miss & is_del)
             (pslot, aslot, d_tslot, dp_slot,
              pfound, afound, d_tfound, dp_found) = lax.cond(
-                any_miss, _resolve_joined, lambda _: hinted, None)
+                any_miss, lambda _: _joined_from(core),
+                lambda _: hinted, None)
     else:
-        (pslot, aslot, d_tslot, dp_slot,
-         pfound, afound, d_tfound, dp_found) = _resolve_joined(None)
+        (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
+         node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
+         pslot, aslot, d_tslot, dp_slot,
+         pfound, afound, d_tfound, dp_found) = _build_sorted_joined(None)
     pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
-    node_anchor_is_sentinel = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
+
+    # Full materialised path: claimed anchor path with the node's own ts
+    # in the last position (Internal/Node.elm:79-82).
+    col = jnp.clip(node_depth - 1, 0, D - 1)
+    fp = node_claimed.at[slot_ids, col].set(
+        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
+        unique_indices=True)
 
     # ---- 5. Local validity per node slot: the claimed prefix must exactly
     # match the parent's materialised path (what "descending the path"

@@ -68,7 +68,8 @@ def _pad_ops_to(ops: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
         pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
         if k == "kind":
             out[k] = np.pad(v, pad_width, constant_values=KIND_PAD)
-        elif k in ("value_ref", "parent_pos", "anchor_pos", "target_pos"):
+        elif k in ("value_ref", "parent_pos", "anchor_pos", "target_pos",
+                   "ts_rank"):
             out[k] = np.pad(v, pad_width, constant_values=-1)
         elif k == "pos":
             out[k] = np.concatenate(

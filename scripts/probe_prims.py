@@ -1,0 +1,90 @@
+"""Primitive-cost probe on the live chip: times each XLA building block
+of the merge kernel at headline width, so per-stage blame is apportioned
+from measured parts rather than guesses.
+
+Honest timing: each repeat is dispatch + forced readback of a dependent
+scalar (bench.honest); the per-call floor (tunnel RPC) is printed first —
+subtract it mentally from every row.
+
+Usage: python scripts/probe_prims.py [N]   (default 1_000_000)
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from crdt_graph_tpu.utils import compcache
+compcache.enable()
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import honest
+
+
+def row(name, fn, *args, repeats=3):
+    f = jax.jit(fn)
+    s = honest.time_with_readback(f, *args, repeats=repeats)
+    print(f"{name:34s} p50 {s['p50_ms']:8.1f} ms  min {s['min_ms']:8.1f}"
+          f"  (warm {s['warm_ms']/1e3:.1f}s)", flush=True)
+    return s["p50_ms"]
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    M = N + 2
+    T = 2 * M
+    rng = np.random.default_rng(0)
+
+    i32a = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    i32b = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    i32c = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    idxN = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    idxT = jnp.asarray(rng.integers(0, T, T, dtype=np.int32))
+    i32t = jnp.asarray(rng.integers(0, T, T, dtype=np.int32))
+    i64N = jnp.asarray(rng.integers(0, 2**40, N, dtype=np.int64))
+
+    fp = honest.fingerprint
+    print(f"N={N}  floor={honest.overhead_floor_ms()} ms", flush=True)
+
+    row("fingerprint(4xN i32) alone", lambda a: fp((a, a, a, a)), i32a)
+    row("sort 2-key (3 arr) N", lambda a, b, c: fp(
+        lax.sort((a, b, c), num_keys=2)), i32a, i32b, i32c)
+    row("sort 3-key (3 arr) M~N", lambda a, b, c: fp(
+        lax.sort((a, b, c), num_keys=3)), i32a, i32b, i32c)
+    row("sort 1-key (1 arr) N", lambda a: fp(lax.sort((a,), num_keys=1)),
+        i32a)
+    row("sort 2-key i64-split N", lambda a: fp(
+        lax.sort(((a >> 32).astype(jnp.int32),
+                  (a & 0xFFFFFFFF).astype(jnp.int32) - 2**31,
+                  jnp.arange(a.shape[0], dtype=jnp.int32)), num_keys=2)),
+        i64N)
+    row("cumsum T (=2M)", lambda a: fp(lax.cumsum(a)), i32t)
+    row("cummax N", lambda a: fp(lax.cummax(a)), i32a)
+    row("gather N<-N i32", lambda a, i: fp(a[i]), i32a, idxN)
+    row("gather T<-T i32", lambda a, i: fp(a[i]), i32t, idxT)
+    row("gather 7xT<-T i32", lambda a, i: fp(
+        jnp.stack([a, a + 1, a + 2, a + 3, a + 4, a + 5, a + 6])[:, i]),
+        i32t, idxT)
+    row("scatter-set N i32 unique", lambda a, i: fp(
+        jnp.zeros_like(a).at[i].set(a, mode="drop", unique_indices=True)),
+        i32a, idxN)
+    row("scatter-set N i32 dup-safe", lambda a, i: fp(
+        jnp.zeros_like(a).at[i].set(a, mode="drop")), i32a, idxN)
+    row("scatter-min N i32", lambda a, i: fp(
+        jnp.full_like(a, 2**31 - 1).at[i].min(a, mode="drop")), i32a, idxN)
+    row("while_loop 10x (gather N)", lambda a, i: fp(
+        lax.while_loop(lambda s: s[1] < 10,
+                       lambda s: (s[0][i], s[1] + 1), (a, jnp.int32(0)))),
+        i32a, idxN)
+    row("gather i64 N", lambda a, i: fp(a[i]), i64N, idxN)
+    row("searchsorted 4N in N (sort)", lambda a, q: fp(
+        jnp.searchsorted(a, q, method="sort")),
+        jnp.sort(i64N), jnp.concatenate([i64N, i64N, i64N, i64N]))
+
+
+if __name__ == "__main__":
+    main()

@@ -279,6 +279,40 @@ def test_hint_modes_agree():
                               np.asarray(tables[0].doc_index))
 
 
+def test_hostile_ranks_fall_back():
+    """Corrupting ts_rank in every distinct way (shuffle, collision, gap,
+    missing, out-of-range) must trip the device-side rank verification
+    and route the batch down the sorted+join branch — identical tables,
+    wrong hints cost speed never correctness (ops/merge.py steps 1-4)."""
+    merged, ops = _random_session(91, n_replicas=3, steps=60)
+    p = packed.pack(ops)
+    base = p.arrays()
+    want_t = view.to_host(merge.materialize(base, hints="join"))
+    want_vals = view.visible_values(want_t, p.values)
+    want_status = view.statuses(want_t, p.num_ops)
+    rng = np.random.default_rng(5)
+    adds = np.nonzero(base["ts_rank"] >= 0)[0]
+
+    def corrupt(name, mutate):
+        arrs = dict(base)
+        r = base["ts_rank"].copy()
+        mutate(r)
+        arrs["ts_rank"] = r
+        t = view.to_host(merge.materialize(arrs))     # auto mode
+        assert view.visible_values(t, p.values) == want_vals, name
+        assert view.statuses(t, p.num_ops) == want_status, name
+        assert np.array_equal(np.asarray(t.doc_index),
+                              np.asarray(want_t.doc_index)), name
+
+    corrupt("shuffled",
+            lambda r: r.__setitem__(adds, rng.permutation(r[adds])))
+    corrupt("collision", lambda r: r.__setitem__(adds[1], r[adds[0]]))
+    corrupt("gap", lambda r: r.__setitem__(adds, r[adds] + 1))
+    corrupt("missing", lambda r: r.__setitem__(adds[2], -1))
+    corrupt("oob", lambda r: r.__setitem__(adds[0], 10**6))
+    corrupt("all_missing", lambda r: r.fill(-1))
+
+
 def test_concat_reresolves_cross_hints():
     """concat must re-resolve each side's unresolved refs against the
     other side so the union's hints stay exhaustive (b's ops anchored in
