@@ -652,27 +652,72 @@ def _materialize(ops: Dict[str, jax.Array],
     # branch T* roots first (group 0), then same-branch T* children (group
     # 1); each group timestamp-DESCENDING (the RGA rule: higher timestamp
     # closer to the anchor) — slot-descending, int32 keys only.
+    #
+    # The sort only has work to do at CROWDED parents (≥ 2 children):
+    # a singleton child needs no ordering at all, and real op logs are
+    # chain-dominated — almost every T* parent has exactly one child, so
+    # the M-wide 3-key sort (the kernel's costliest stage once the
+    # timestamp sort moved to ingest) would re-sort a million rows to
+    # order a few dozen contested sibling groups.  Instead: count
+    # children per parent (one scatter-add), compact the crowded rows by
+    # prefix-sum, and sort only those at a small static width S_CAP,
+    # falling back to the full-width sort when the batch is adversarially
+    # contested (wide-fanout combs, descending rounds).  Both branches
+    # produce identical (sib_next, first_child).
     order_parent = jnp.where(in_forest, star_parent, order_parent)
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
-    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
+
+    def _sib_links(kp, gg, neg):
+        """Sibling links from a 3-key sort at the input width; rows with
+        ``neg == IPOS`` are padding (slot maps to M, scatters drop)."""
+        s_parent, _, s_neg = lax.sort((kp, gg, neg), num_keys=3)
+        s_slot = jnp.where(s_neg == IPOS, M, -s_neg)
+        same_parent = (s_parent[1:] == s_parent[:-1]) & (s_slot[1:] < M)
+        sib = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
+            jnp.where(same_parent, s_slot[1:], -1),
+            mode="drop", unique_indices=True)
+        s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
+        fc_tgt = jnp.where(s_start & (s_slot < M), s_parent, M)
+        fc = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
+            s_slot, mode="drop", unique_indices=True)
+        return sib, fc
+
+    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
-    # the negated-slot key doubles as the payload: forest rows recover
-    # their slot as -neg, parked rows (IPOS) map out of range and their
-    # scatters drop — no fourth array through the sort network
-    s_parent, _, s_neg = lax.sort((skey, ggrp, neg_slot), num_keys=3)
-    s_slot = jnp.where(s_neg == IPOS, M, -s_neg)
-    same_parent = s_parent[1:] == s_parent[:-1]
-    # next sibling within the concatenated child list; the root never sits
-    # in a sibling list (its exit token is the chain terminal below)
-    sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
-        jnp.where(same_parent, s_slot[1:], -1),
-        mode="drop", unique_indices=True).at[ROOT].set(-1)
-    # first child of each parent = slot at every parent-run start
-    s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
-    fc_tgt = jnp.where(s_start, s_parent, M)     # non-starts dropped (OOB)
-    first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
-        s_slot, mode="drop", unique_indices=True).at[NULL].set(-1)
+    S_CAP = 1 << 16
+    if S_CAP >= M:
+        sib_next, first_child = _sib_links(skey, ggrp, neg_slot)
+    else:
+        par = jnp.where(in_forest, order_parent, M)
+        cnt = jnp.zeros(M, jnp.int32).at[par].add(1, mode="drop")
+        crowded = in_forest & (cnt[jnp.minimum(par, M - 1)] >= 2)
+        cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
+        n_crowded = cpos[M - 1] + 1
+
+        def br_small(_):
+            at = jnp.where(crowded, cpos, S_CAP)
+            kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                skey, mode="drop", unique_indices=True)
+            gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
+                ggrp, mode="drop", unique_indices=True)
+            neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                neg_slot, mode="drop", unique_indices=True)
+            sib, fc = _sib_links(kp, gg, neg)
+            # singleton children: the parent's whole child list
+            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
+            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
+                       ].set(jnp.where(single_v < M, single_v, -1),
+                             mode="drop", unique_indices=True)
+            return sib, fc
+
+        sib_next, first_child = lax.cond(
+            n_crowded <= S_CAP, br_small,
+            lambda _: _sib_links(skey, ggrp, neg_slot), None)
+    # the root never sits in a sibling list (its exit token is the chain
+    # terminal below)
+    sib_next = sib_next.at[ROOT].set(-1)
+    first_child = first_child.at[NULL].set(-1)
 
     # ---- 10. Euler tour: enter(v) = token v, exit(v) = token M + v.
     # Successors form one chain per tree ending in the self-loop at

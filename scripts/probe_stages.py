@@ -1,23 +1,41 @@
-"""Prefix-staged honest timing of the merge kernel on the real chip.
+"""Prefix-staged honest timing of the merge kernel on the current device.
 
 Times the kernel truncated after each stage; consecutive differences
 apportion device time per stage (each prefix is its own jit compile).
+MIRRORS ops/merge.py's ranked+hinted path (r3 kernel) — keep the cut
+points in sync when the kernel changes.
+
+Stages:
+ 1  ranked slot assignment + scatters + link-hint resolution (steps 1-4)
+ 2  + materialised paths + local validity (step 5)
+ 3  + validity cascade / cycles (step 6)
+ 4  + deletes + dead propagation (steps 7-8)
+ 5  + NSA chase + sibling sort + tour successors (steps 9-10)
+ 6  + run contraction + Wyllie (step 12 first half)
+ 7  + rank expansion + orders (step 12 second half)
+ 8  full kernel incl. statuses (= merge._materialize)
+
+Usage: python scripts/probe_stages.py [N] [stage...]
 """
 import sys
-sys.path.insert(0, "/root/repo")
-import time
 
-import numpy as np
+sys.path.insert(0, "/root/repo")
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from crdt_graph_tpu.utils import compcache
+compcache.enable()
 jax.config.update("jax_enable_x64", True)
 
+from crdt_graph_tpu.bench import honest
 from crdt_graph_tpu.bench.workloads import chain_workload
-from crdt_graph_tpu.codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
-from crdt_graph_tpu.ops.merge import (_ceil_log2, _split_ts, _fix_and,
-                                      _fix_min, IPOS, BIG)
+from crdt_graph_tpu.codec.packed import KIND_ADD, KIND_DELETE
+from crdt_graph_tpu.ops import merge as merge_mod
+from crdt_graph_tpu.ops import mono_gather
+from crdt_graph_tpu.ops.merge import (_ceil_log2, _fix_and, _fix_min,
+                                      IPOS, BIG)
 
 
 def checksum(*arrs):
@@ -30,8 +48,7 @@ def checksum(*arrs):
 
 
 def staged(ops, stage):
-    """Body of _materialize, truncated after `stage`, returning a checksum
-    of that stage's live outputs."""
+    """ops/merge.py's ranked+hinted path, truncated after ``stage``."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -47,73 +64,58 @@ def staged(ops, stage):
     ROOT = 0
     NULL = M - 1
     slot_ids = jnp.arange(M, dtype=jnp.int32)
-
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
-
-    sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
-    ts_hi, ts_lo = _split_ts(sort_ts)
-    s_hi, s_lo, sorted_pos, sorted_idx = lax.sort(
-        (ts_hi, ts_lo, pos, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
-    sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
-        (s_lo.astype(jnp.int64) + 2**31)
-    run_start = jnp.concatenate(
-        [jnp.ones(1, bool),
-         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
-    not_big = s_hi < (BIG >> 32)
-    is_canon = run_start & not_big
-    canon_pos = lax.cummax(jnp.where(run_start,
-                                     jnp.arange(N, dtype=jnp.int32), 0))
-    slot_of_sorted = canon_pos + 1
-    op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
-        jnp.where(not_big, slot_of_sorted, NULL))
-    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(~run_start & not_big)
-    if stage == 1:
-        return checksum(op_slot, op_is_dup, sorted_ts)
-
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
-    tgt = jnp.where(is_canon, slot_of_sorted, NULL)
 
-    def scat(init, vals, at=tgt):
-        return init.at[at].set(vals, mode="drop")
+    # ---- steps 1-4, ranked branch (trust hints like "exhaustive" so the
+    # probe profiles the path real merges execute)
+    rank = ops["ts_rank"].astype(jnp.int32)
+    is_real_add = is_add & (ts > 0) & (ts < BIG)
+    has_rank = is_real_add & (rank >= 0) & (rank < N)
+    op_slot = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
+    win = jnp.full(M, IPOS, jnp.int32).at[
+        jnp.where(has_rank, op_slot, M)].min(pos, mode="drop")
+    is_canon_op = has_rank & (pos == win[op_slot])
+    op_is_dup = has_rank & ~is_canon_op
+    tgt_op = jnp.where(is_canon_op, op_slot, M)
 
-    g = lambda a: a[sorted_idx]  # noqa: E731
-    node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts).at[ROOT].set(0) \
-        .at[NULL].set(BIG)
-    node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
-    node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
-    node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
-    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
-        paths[sorted_idx], mode="drop")
-    is_node_slot = scat(jnp.zeros(M, bool), is_canon)
+    def scat_op(init, vals):
+        return init.at[tgt_op].set(vals, mode="drop", unique_indices=True)
+
+    node_ts = scat_op(jnp.full(M, BIG, jnp.int64), ts) \
+        .at[ROOT].set(0).at[NULL].set(BIG)
+    node_depth = scat_op(jnp.zeros(M, jnp.int32), depth).at[ROOT].set(0)
+    node_value_ref = scat_op(jnp.full(M, -1, jnp.int32), value_ref)
+    node_pos = win
+    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt_op].set(
+        paths, mode="drop", unique_indices=True)
+    is_node_slot = scat_op(jnp.zeros(M, bool), jnp.ones(N, bool))
+    node_anchor_is_sentinel = scat_op(jnp.zeros(M, bool), anchor_ts == 0)
+
+    def _res(hint, want):
+        p = jnp.clip(hint, 0, N - 1)
+        ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
+            (want > 0) & (want < BIG)
+        slot = jnp.where(want == 0, ROOT, jnp.where(ok, op_slot[p], NULL))
+        return slot.astype(jnp.int32), (want == 0) | ok
+
+    pp_slot, pp_found = _res(ops["parent_pos"].astype(jnp.int32), parent_ts)
+    aa_slot, aa_found = _res(ops["anchor_pos"].astype(jnp.int32), anchor_ts)
+    d_tslot, d_tfound = _res(ops["target_pos"].astype(jnp.int32), ts)
+    dp_slot, dp_found = pp_slot, pp_found
+    pslot = scat_op(jnp.full(M, NULL, jnp.int32), pp_slot)
+    aslot = scat_op(jnp.full(M, NULL, jnp.int32), aa_slot)
+    pfound = scat_op(jnp.zeros(M, bool), pp_found)
+    afound = scat_op(jnp.zeros(M, bool), aa_found)
+    pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
+    if stage == 1:
+        return checksum(op_slot, op_is_dup, node_ts, pslot, aslot)
 
     col = jnp.clip(node_depth - 1, 0, D - 1)
     fp = node_claimed.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]))
-    if stage == 2:
-        return checksum(node_ts, node_depth, fp, is_node_slot)
-
-    queries = jnp.concatenate([
-        scat(jnp.zeros(M, jnp.int64), g(parent_ts)),
-        scat(jnp.zeros(M, jnp.int64), g(anchor_ts)),
-        ts,
-        parent_ts,
-    ])
-    qidx = jnp.searchsorted(sorted_ts, queries, side="left").astype(jnp.int32)
-    qidx_c = jnp.minimum(qidx, N - 1)
-    qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & (queries < BIG)
-    qslot = jnp.where(queries == 0, ROOT,
-                      jnp.where(qhit, qidx_c + 1, NULL))
-    qfound = (queries == 0) | qhit
-    pslot, aslot = qslot[:M], qslot[M:2 * M]
-    pfound, afound = qfound[:M], qfound[M:2 * M]
-    d_tslot, dp_slot = qslot[2 * M:2 * M + N], qslot[2 * M + N:]
-    d_tfound, dp_found = qfound[2 * M:2 * M + N], qfound[2 * M + N:]
-    pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
-    node_anchor_is_sentinel = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
-    if stage == 3:
-        return checksum(pslot, aslot, d_tslot, dp_slot)
-
+        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
+        unique_indices=True)
     prefix_ok = jnp.all(
         jnp.where(cols < node_depth[:, None] - 1,
                   node_claimed == fp[pslot], True), axis=1)
@@ -124,17 +126,33 @@ def staged(ops, stage):
         (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
     local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
     local_ok = local_ok.at[ROOT].set(True)
-    if stage == 4:
-        return checksum(local_ok, parent_ok)
+    if stage == 2:
+        return checksum(local_ok, parent_ok, fp)
 
     order_parent = jnp.where(node_anchor_is_sentinel, pslot, aslot)
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
     cascade_ok = _fix_and(local_ok | ~is_node_slot, order_parent,
                           _ceil_log2(M) + 1)
-    valid = cascade_ok & is_node_slot
+    up_edge = jnp.any(is_node_slot & ~node_anchor_is_sentinel &
+                      (aslot != NULL) & (aslot >= slot_ids))
+
+    def _reaches_terminal(ptr):
+        k_cap = _ceil_log2(M) + 1
+
+        def body(state):
+            p, i = state
+            return p[p], i + 1
+
+        p, _ = lax.while_loop(lambda s: s[1] < k_cap, body,
+                              (ptr, jnp.int32(0)))
+        return (p == ROOT) | (p == NULL)
+
+    acyclic = lax.cond(up_edge, _reaches_terminal,
+                       lambda p: jnp.ones(M, bool), order_parent)
+    valid = cascade_ok & acyclic & is_node_slot
     valid = valid.at[ROOT].set(True)
     parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
-    if stage == 5:
+    if stage == 3:
         return checksum(valid, parent_eff)
 
     d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
@@ -146,15 +164,12 @@ def staged(ops, stage):
     deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
     del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
         .at[NULL].set(IPOS)
-    if stage == 6:
-        return checksum(deleted, del_pos)
-
     anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
     anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
                        _ceil_log2(D) + 1)
     dead = valid & (anc_del < IPOS)
-    if stage == 7:
-        return checksum(dead, anc_del)
+    if stage == 4:
+        return checksum(deleted, dead, anc_del)
 
     in_forest = valid & is_node_slot
     mptr0 = jnp.where(node_anchor_is_sentinel | ~in_forest, -1, aslot)
@@ -173,25 +188,56 @@ def staged(ops, stage):
     mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
     star_parent = jnp.where(mptr >= 0, mptr, pslot)
     star_sentinel = mptr < 0
-    if stage == 8:
-        return checksum(star_parent, star_sentinel)
 
     order_parent = jnp.where(in_forest, star_parent, order_parent)
     order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
-    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
+
+    def _sib_links(kp, gg, neg):
+        s_parent, _, s_neg = lax.sort((kp, gg, neg), num_keys=3)
+        s_slot = jnp.where(s_neg == IPOS, M, -s_neg)
+        same_parent = (s_parent[1:] == s_parent[:-1]) & (s_slot[1:] < M)
+        sib = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
+            jnp.where(same_parent, s_slot[1:], -1),
+            mode="drop", unique_indices=True)
+        s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
+        fc_tgt = jnp.where(s_start & (s_slot < M), s_parent, M)
+        fc = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
+            s_slot, mode="drop", unique_indices=True)
+        return sib, fc
+
+    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
-    s_parent, _, _, s_slot = lax.sort(
-        (skey, ggrp, neg_slot, slot_ids), num_keys=3)
-    same_parent = s_parent[1:] == s_parent[:-1]
-    sib_next = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
-        jnp.where(same_parent, s_slot[1:], -1)).at[ROOT].set(-1)
-    s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
-    fc_tgt = jnp.where(s_start, s_parent, NULL)
-    first_child = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
-        s_slot, mode="drop").at[NULL].set(-1)
-    if stage == 9:
-        return checksum(sib_next, first_child)
+    S_CAP = 1 << 16
+    if S_CAP >= M:
+        sib_next, first_child = _sib_links(skey, ggrp, neg_slot)
+    else:
+        par = jnp.where(in_forest, order_parent, M)
+        cnt = jnp.zeros(M, jnp.int32).at[par].add(1, mode="drop")
+        crowded = in_forest & (cnt[jnp.minimum(par, M - 1)] >= 2)
+        cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
+        n_crowded = cpos[M - 1] + 1
+
+        def br_small(_):
+            at = jnp.where(crowded, cpos, S_CAP)
+            kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                skey, mode="drop", unique_indices=True)
+            gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
+                ggrp, mode="drop", unique_indices=True)
+            neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                neg_slot, mode="drop", unique_indices=True)
+            sib, fc = _sib_links(kp, gg, neg)
+            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
+            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
+                       ].set(jnp.where(single_v < M, single_v, -1),
+                             mode="drop", unique_indices=True)
+            return sib, fc
+
+        sib_next, first_child = lax.cond(
+            n_crowded <= S_CAP, br_small,
+            lambda _: _sib_links(skey, ggrp, neg_slot), None)
+    sib_next = sib_next.at[ROOT].set(-1)
+    first_child = first_child.at[NULL].set(-1)
 
     T = 2 * M
     tok = jnp.arange(T, dtype=jnp.int32)
@@ -204,6 +250,8 @@ def staged(ops, stage):
         ~in_tour, M + slot_ids,
         jnp.where(sib_next >= 0, sib_next, up))
     succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
+    if stage == 5:
+        return checksum(succ, sib_next, first_child)
 
     exists = valid & is_node_slot
     tomb = deleted & exists
@@ -215,15 +263,15 @@ def staged(ops, stage):
     same_run = fwd | bwd
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
     rid = lax.cumsum(boundary.astype(jnp.int32)) - 1
-    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(tok)
-    run_e = jnp.zeros(T, jnp.int32).at[rid].max(tok)
+    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(
+        tok, indices_are_sorted=True)
+    run_e = jnp.zeros(T, jnp.int32).at[rid].max(
+        tok, indices_are_sorted=True)
     run_fwd = succ[run_s] == run_s + 1
     run_tail = jnp.where(run_fwd, run_e, run_s)
     tail_succ = succ[run_tail]
     run_terminal = tail_succ == run_tail
     run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
-    if stage == 10:
-        return checksum(run_next, run_s, run_e)
 
     zeros_m = jnp.zeros(M, jnp.int32)
     w_doc = jnp.concatenate([exists.astype(jnp.int32), zeros_m])
@@ -234,95 +282,88 @@ def staged(ops, stage):
     def run_sum(cse):
         return jnp.where(run_terminal, 0, cse[run_e + 1] - cse[run_s])
 
-    wy_cap = _ceil_log2(T) + 1
+    def _wyllie(a, b, p, cap):
+        def wy_cond(state):
+            _, _, _, live, i = state
+            return live & (i < cap)
 
-    def wy_cond(state):
-        _, _, _, live, i = state
-        return live & (i < wy_cap)
+        def wy_body(state):
+            a, b, p, _, i = state
+            return a + a[p], b + b[p], p[p], jnp.any(p[p] != p), i + 1
 
-    def wy_body(state):
-        a, b, p, _, i = state
-        a2 = a + a[p]
-        b2 = b + b[p]
-        p2 = p[p]
-        return a2, b2, p2, jnp.any(p2 != p), i + 1
+        a, b, _, _, _ = lax.while_loop(
+            wy_cond, wy_body, (a, b, p, jnp.array(True), jnp.int32(0)))
+        return a, b
 
-    a_doc, a_vis, _, _, _ = lax.while_loop(
-        wy_cond, wy_body,
-        (run_sum(cse_doc), run_sum(cse_vis), run_next, jnp.array(True),
-         jnp.int32(0)))
-    if stage == 11:
-        return checksum(a_doc, a_vis)
+    a0, b0 = run_sum(cse_doc), run_sum(cse_vis)
+    R_CAP = 1 << 15
+    if R_CAP >= T:
+        a_doc, a_vis = _wyllie(a0, b0, run_next, _ceil_log2(T) + 1)
+    else:
+        n_runs = rid[T - 1] + 1
 
-    def rank_of(a, cse):
-        within = jnp.where(run_fwd[rid],
-                           cse[tok] - cse[run_s[rid]],
-                           cse[run_e[rid] + 1] - cse[tok + 1])
-        e_tok = a[rid] - within
+        def br_small(args):
+            a, b, p = args
+            a_s, b_s = _wyllie(a[:R_CAP], b[:R_CAP],
+                               jnp.minimum(p[:R_CAP], R_CAP - 1),
+                               _ceil_log2(R_CAP) + 1)
+            pad = jnp.zeros(T - R_CAP, jnp.int32)
+            return (jnp.concatenate([a_s, pad]),
+                    jnp.concatenate([b_s, pad]))
+
+        def br_full(args):
+            a, b, p = args
+            return _wyllie(a, b, p, _ceil_log2(T) + 1)
+
+        a_doc, a_vis = lax.cond(n_runs <= R_CAP, br_small, br_full,
+                                (a0, b0, run_next))
+    if stage == 6:
+        return checksum(a_doc, a_vis, rid)
+
+    per_run = jnp.stack([
+        run_fwd.astype(jnp.int32),
+        cse_doc[run_s], cse_doc[run_e + 1], a_doc,
+        cse_vis[run_s], cse_vis[run_e + 1], a_vis,
+    ])
+    ex = mono_gather.monotone_gather(per_run, rid)
+    rf_t = ex[0].astype(bool)
+
+    def rank_of(ws_t, we1_t, a_t, cse):
+        within = jnp.where(rf_t, cse[:T] - ws_t, we1_t - cse[1:T + 1])
+        e_tok = a_t - within
         return e_tok[ROOT] - e_tok[:M]
 
-    doc_dense = rank_of(a_doc, cse_doc)
-    vis_dense = rank_of(a_vis, cse_vis)
-
+    doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
+    vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
     doc_index = jnp.where(exists, doc_dense, IPOS)
     order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(exists, doc_dense, M)].set(slot_ids, mode="drop")
+        jnp.where(exists, doc_dense, M)].set(
+            slot_ids, mode="drop", unique_indices=True)
     visible_order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(visible, vis_dense, M)].set(slot_ids, mode="drop")
-    if stage == 12:
+        jnp.where(visible, vis_dense, M)].set(
+            slot_ids, mode="drop", unique_indices=True)
+    if stage == 7:
         return checksum(doc_index, order, visible_order)
 
-    status = jnp.full(N, PAD := jnp.int8(4), jnp.int8)
-    a_slot = op_slot
-    a_valid = valid[a_slot]
-    a_parent_ok = parent_ok[a_slot]
-    a_absorbed = a_valid & (anc_del[a_slot] < pos)
-    a_sentinel = ts <= 0
-    a_status = jnp.where(
-        a_sentinel | (a_valid & (op_is_dup | a_absorbed)), 1,
-        jnp.where(a_valid, 0,
-                  jnp.where(a_parent_ok & valid[pslot[a_slot]], 2, 3)))
-    status = jnp.where(is_add, a_status.astype(jnp.int8), status)
-    d_parent_ok = (depth == 1) | ((depth >= 2) & dp_found & valid[dp_slot])
-    d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
-    d_repeat = d_ok & (del_pos[d_tslot] < pos)
-    d_target_later = d_ok & (node_pos[d_tslot] > pos)
-    d_sentinel = (ts == 0) & d_parent_ok
-    d_status = jnp.where(
-        d_sentinel | d_anc_absorbed | (d_repeat & ~d_target_later), 1,
-        jnp.where(d_ok & ~d_target_later, 0,
-                  jnp.where(d_target_later | d_parent_ok, 2, 3)))
-    status = jnp.where(is_del, d_status.astype(jnp.int8), status)
-    return checksum(doc_index, order, visible_order, status,
-                    jnp.sum(visible).astype(jnp.int32))
-
-
-def force(x):
-    return np.asarray(jax.device_get(x))
+    t = merge_mod._materialize(ops)
+    return checksum(t.doc_index, t.order, t.visible_order, t.status,
+                    t.num_visible)
 
 
 def main():
-    ops = chain_workload(64, 1_000_000)
-    dev_ops = jax.device_put(ops)
-    stages = list(range(1, 14))
-    if len(sys.argv) > 1:
-        stages = [int(a) for a in sys.argv[1:]]
+    args = [int(a) for a in sys.argv[1:]]
+    n = args[0] if args else 1_000_000
+    stages = args[1:] or list(range(1, 9))
+    ops = jax.device_put(chain_workload(64, n))
     prev = 0.0
     for st in stages:
         fn = jax.jit(staged, static_argnums=1)
-        t0 = time.perf_counter()
-        force(fn(dev_ops, st))
-        warm = time.perf_counter() - t0
-        times = []
-        for _ in range(2):
-            t0 = time.perf_counter()
-            force(fn(dev_ops, st))
-            times.append(time.perf_counter() - t0)
-        p50 = min(times)
-        print(f"stage {st:2d}: p50 {p50*1e3:9.1f} ms   "
-              f"delta {(p50-prev)*1e3:9.1f} ms   (compile+warm {warm:.1f}s)",
-              flush=True)
+        s = honest.time_with_readback(fn, ops, st, repeats=3)
+        p50 = s["p50_ms"]
+        print(f"stage {st}: p50 {p50:9.1f} ms   delta {p50 - prev:9.1f} ms"
+              f"   (compile+warm {s['warm_ms']/1e3:.1f}s)", flush=True)
         prev = p50
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,57 @@
+"""The crowded-compaction sibling sort (ops/merge.py step 9) only
+engages above S_CAP = 65536 slots — the regular suites run far below it,
+so these cases cross the threshold on each cond branch:
+
+- chain workload: 64 crowded rows among 70k (small-sort branch);
+- tombstone-heavy at 76k ops: 40k crowded root children + deletes
+  (small-sort branch with a contested parent and dead masking);
+- descending rounds: every op is a root child (full-sort fallback).
+
+Each pins the full visible sequence against its closed form / mirror.
+"""
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import workloads
+from crdt_graph_tpu.core.operation import Add
+from crdt_graph_tpu.host_tree import HostTree
+from crdt_graph_tpu.ops import merge, view
+
+N = 70_000
+
+
+def _visible_ts(arrs):
+    t = view.to_host(merge.materialize(arrs))
+    nv = int(t.num_visible)
+    return np.asarray(t.ts)[np.asarray(t.visible_order)[:nv]]
+
+
+def test_chain_small_branch_above_cap():
+    got = _visible_ts(workloads.chain_workload(64, N))
+    want = workloads.chain_expected_ts(64, N)
+    assert got.shape == want.shape and np.array_equal(got, want)
+
+
+def test_descending_full_branch_above_cap():
+    got = _visible_ts(workloads.descending_chains(64, N))
+    want = workloads.descending_expected_ts(64, N)
+    assert got.shape == want.shape and np.array_equal(got, want)
+
+
+def test_tombstone_heavy_crowded_small_branch():
+    ops = workloads.tombstone_heavy(n_adds=40_000)   # + 36k deletes = 76k
+    from crdt_graph_tpu.codec import packed
+    p = packed.pack(ops)
+    assert p.capacity > 1 << 16                      # crosses S_CAP
+    got = _visible_ts(p.arrays())
+    m = HostTree(16)
+    for op in ops:
+        if isinstance(op, Add):
+            m.apply_add(op.ts, tuple(op.path), op.value)
+        else:
+            m.apply_delete(tuple(op.path))
+    want = np.array([int(m.ts[s]) for s in m.iter_visible()], dtype=np.int64)
+    assert got.shape == want.shape and np.array_equal(got, want)
