@@ -769,17 +769,21 @@ def _materialize(ops: Dict[str, jax.Array],
     run_terminal = tail_succ == run_tail
     run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
 
-    # token weights and their exclusive prefix sums (runs are contiguous,
-    # so within-run partial sums come from one global cumsum)
-    zeros_m = jnp.zeros(M, jnp.int32)
-    w_doc = jnp.concatenate([exists.astype(jnp.int32), zeros_m])
-    w_vis = jnp.concatenate([visible.astype(jnp.int32), zeros_m])
-    cse_doc = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_doc)])
-    cse_vis = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_vis)])
+    # Token weights and their exclusive prefix sums.  Only ENTER tokens
+    # (the first M) carry weight — exit tokens count nothing — so the
+    # prefix sums run at M+1 width and any token index x reads as
+    # ``cse[min(x, M)]`` (runs never straddle the enter/exit boundary,
+    # and every exit-space token sits at the final prefix value).
+    cse_doc = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
+    cse_vis = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), lax.cumsum(visible.astype(jnp.int32))])
+    run_s_c = jnp.minimum(run_s, M)
+    run_e1_c = jnp.minimum(run_e + 1, M)
     # per-run total weight; zero-weight absorbing (terminal) runs make the
     # Wyllie telescoping exact once pointers collapse
     def run_sum(cse):
-        return jnp.where(run_terminal, 0, cse[run_e + 1] - cse[run_s])
+        return jnp.where(run_terminal, 0, cse[run_e1_c] - cse[run_s_c])
 
     def _wyllie(a, b, p, cap):
         def wy_cond(state):
@@ -829,23 +833,31 @@ def _materialize(ops: Dict[str, jax.Array],
 
     # E(tok) = weight at-or-after tok along the chain; within-run offsets
     # from the global cumsum (forward runs count from the run start,
-    # backward runs toward it)
-    # Expand per-run values back to tokens.  These are the kernel's
-    # monotone-bounded gathers (rid is nondecreasing with increments
-    # ≤ 1), served by the pallas kernel on TPU — one DMA-tiled pass for
-    # all seven rows instead of seven generic 2M-wide XLA gathers.
+    # backward runs toward it).
+    # Expand per-run values back to tokens.  Ranks are read only at ENTER
+    # tokens (rank(v) needs e_tok at enter(v), tokens 0..M-1), so the
+    # expansion and the rank arithmetic run at M width — half the tour.
+    # These are the kernel's monotone-bounded gathers (rid is
+    # nondecreasing with increments ≤ 1), served by the pallas kernel on
+    # TPU — one DMA-tiled pass for all seven rows instead of seven
+    # generic M-wide XLA gathers.
+    # rid[:M] < M (rid climbs by ≤ 1 from 0), so the expansion sources
+    # slice to the first M runs too — the input build matches the
+    # half-width output
     per_run = jnp.stack([
-        run_fwd.astype(jnp.int32),
-        cse_doc[run_s], cse_doc[run_e + 1], a_doc,
-        cse_vis[run_s], cse_vis[run_e + 1], a_vis,
+        run_fwd[:M].astype(jnp.int32),
+        cse_doc[run_s_c[:M]], cse_doc[run_e1_c[:M]], a_doc[:M],
+        cse_vis[run_s_c[:M]], cse_vis[run_e1_c[:M]], a_vis[:M],
     ])
-    ex = mono_gather.monotone_gather(per_run, rid, use_pallas=use_pallas)
-    rf_t = ex[0].astype(bool)
+    ex = mono_gather.monotone_gather(per_run, rid[:M],
+                                     use_pallas=use_pallas)
+    rf_m = ex[0].astype(bool)
 
-    def rank_of(ws_t, we1_t, a_t, cse):
-        within = jnp.where(rf_t, cse[:T] - ws_t, we1_t - cse[1:T + 1])
-        e_tok = a_t - within
-        return e_tok[ROOT] - e_tok[:M]
+    def rank_of(ws_m, we1_m, a_m, cse):
+        # enter tokens are 0..M-1, so cse[tok] and cse[tok+1] slice clean
+        within = jnp.where(rf_m, cse[:M] - ws_m, we1_m - cse[1:M + 1])
+        e_tok = a_m - within
+        return e_tok[ROOT] - e_tok
 
     doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
     vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
@@ -858,12 +870,19 @@ def _materialize(ops: Dict[str, jax.Array],
         jnp.where(visible, vis_dense, M)].set(
             slot_ids, mode="drop", unique_indices=True)
 
-    # ---- 13. Sequential-parity statuses per op.
+    # ---- 13. Sequential-parity statuses per op.  Per-slot facts pack
+    # into one int32 so each op needs two gathers (meta + anc_del), not
+    # five separate ones.
+    meta = (valid.astype(jnp.int32)
+            | (parent_ok.astype(jnp.int32) << 1)
+            | (valid[pslot].astype(jnp.int32) << 2))
     status = jnp.full(N, PAD, jnp.int8)
     # adds
     a_slot = op_slot
-    a_valid = valid[a_slot]
-    a_parent_ok = parent_ok[a_slot]
+    a_meta = meta[a_slot]
+    a_valid = (a_meta & 1) != 0
+    a_parent_ok = (a_meta & 2) != 0
+    a_grandvalid = (a_meta & 4) != 0     # valid[pslot[a_slot]]
     a_absorbed = a_valid & (anc_del[a_slot] < pos)
     # an Add with ts 0 collides with the branch-head sentinel: the reference
     # finds an existing child and reports AlreadyApplied
@@ -871,11 +890,12 @@ def _materialize(ops: Dict[str, jax.Array],
     a_status = jnp.where(
         a_sentinel | (a_valid & (op_is_dup | a_absorbed)), ALREADY_APPLIED,
         jnp.where(a_valid, APPLIED,
-                  jnp.where(a_parent_ok & valid[pslot[a_slot]], NOT_FOUND,
+                  jnp.where(a_parent_ok & a_grandvalid, NOT_FOUND,
                             INVALID_PATH)))
     status = jnp.where(is_add, a_status.astype(jnp.int8), status)
     # deletes
-    d_parent_ok = (depth == 1) | ((depth >= 2) & dp_found & valid[dp_slot])
+    d_parent_ok = (depth == 1) | \
+        ((depth >= 2) & dp_found & ((meta[dp_slot] & 1) != 0))
     d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
     d_repeat = d_ok & (del_pos[d_tslot] < pos)
     d_target_later = d_ok & (node_pos[d_tslot] > pos)

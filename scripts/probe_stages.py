@@ -273,14 +273,15 @@ def staged(ops, stage):
     run_terminal = tail_succ == run_tail
     run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
 
-    zeros_m = jnp.zeros(M, jnp.int32)
-    w_doc = jnp.concatenate([exists.astype(jnp.int32), zeros_m])
-    w_vis = jnp.concatenate([visible.astype(jnp.int32), zeros_m])
-    cse_doc = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_doc)])
-    cse_vis = jnp.concatenate([jnp.zeros(1, jnp.int32), lax.cumsum(w_vis)])
+    cse_doc = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
+    cse_vis = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), lax.cumsum(visible.astype(jnp.int32))])
+    run_s_c = jnp.minimum(run_s, M)
+    run_e1_c = jnp.minimum(run_e + 1, M)
 
     def run_sum(cse):
-        return jnp.where(run_terminal, 0, cse[run_e + 1] - cse[run_s])
+        return jnp.where(run_terminal, 0, cse[run_e1_c] - cse[run_s_c])
 
     def _wyllie(a, b, p, cap):
         def wy_cond(state):
@@ -321,17 +322,17 @@ def staged(ops, stage):
         return checksum(a_doc, a_vis, rid)
 
     per_run = jnp.stack([
-        run_fwd.astype(jnp.int32),
-        cse_doc[run_s], cse_doc[run_e + 1], a_doc,
-        cse_vis[run_s], cse_vis[run_e + 1], a_vis,
+        run_fwd[:M].astype(jnp.int32),
+        cse_doc[run_s_c[:M]], cse_doc[run_e1_c[:M]], a_doc[:M],
+        cse_vis[run_s_c[:M]], cse_vis[run_e1_c[:M]], a_vis[:M],
     ])
-    ex = mono_gather.monotone_gather(per_run, rid)
-    rf_t = ex[0].astype(bool)
+    ex = mono_gather.monotone_gather(per_run, rid[:M])
+    rf_m = ex[0].astype(bool)
 
-    def rank_of(ws_t, we1_t, a_t, cse):
-        within = jnp.where(rf_t, cse[:T] - ws_t, we1_t - cse[1:T + 1])
-        e_tok = a_t - within
-        return e_tok[ROOT] - e_tok[:M]
+    def rank_of(ws_m, we1_m, a_m, cse):
+        within = jnp.where(rf_m, cse[:M] - ws_m, we1_m - cse[1:M + 1])
+        e_tok = a_m - within
+        return e_tok[ROOT] - e_tok
 
     doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
     vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
