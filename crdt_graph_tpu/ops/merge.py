@@ -756,18 +756,15 @@ def _materialize(ops: Dict[str, jax.Array],
     same_run = fwd | bwd
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
     rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
-    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(
-        tok, indices_are_sorted=True)
-    run_e = jnp.zeros(T, jnp.int32).at[rid].max(
-        tok, indices_are_sorted=True)
-    # direction: +1 when the run's start token links forward (runs never
-    # straddle the enter/exit boundary: token M-1 is the parked NULL slot's
-    # enter and token M the terminal, neither links ±1)
-    run_fwd = succ[run_s] == run_s + 1
-    run_tail = jnp.where(run_fwd, run_e, run_s)
-    tail_succ = succ[run_tail]
-    run_terminal = tail_succ == run_tail
-    run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
+    end_mask = jnp.concatenate([boundary[1:], jnp.ones(1, bool)])
+    # one unique-set scatter per bound (each run has exactly one start
+    # and one end token) — cheaper than min/max combiner scatters
+    run_s = jnp.full(T, IPOS, jnp.int32).at[
+        jnp.where(boundary, rid, T)].set(tok, mode="drop",
+                                         unique_indices=True)
+    run_e = jnp.zeros(T, jnp.int32).at[
+        jnp.where(end_mask, rid, T)].set(tok, mode="drop",
+                                         unique_indices=True)
 
     # Token weights and their exclusive prefix sums.  Only ENTER tokens
     # (the first M) carry weight — exit tokens count nothing — so the
@@ -778,17 +775,34 @@ def _materialize(ops: Dict[str, jax.Array],
         [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
     cse_vis = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), lax.cumsum(visible.astype(jnp.int32))])
-    run_s_c = jnp.minimum(run_s, M)
-    run_e1_c = jnp.minimum(run_e + 1, M)
-    # per-run total weight; zero-weight absorbing (terminal) runs make the
-    # Wyllie telescoping exact once pointers collapse
-    def run_sum(cse):
-        return jnp.where(run_terminal, 0, cse[run_e1_c] - cse[run_s_c])
 
-    def _wyllie(a, b, p, cap):
+    def _expand(run_s_w, run_e_w):
+        """Per-run chain data at width ``run_s_w.shape[0]`` → Wyllie →
+        the [7, M] token expansion (direction flag, weight-window
+        bounds, suffix weights), via the monotone gather over rid[:M]
+        (ranks are read only at ENTER tokens; rid[:M] < M since rid
+        climbs by ≤ 1 from 0).  Direction: a run is forward when its
+        start token links to start+1 (runs never straddle the
+        enter/exit boundary: token M-1 is the parked NULL slot's enter
+        and token M the terminal, neither links ±1)."""
+        w = run_s_w.shape[0]
+        run_fwd = succ[jnp.minimum(run_s_w, T - 1)] == run_s_w + 1
+        run_tail = jnp.where(run_fwd, run_e_w, run_s_w)
+        tail_succ = succ[jnp.minimum(run_tail, T - 1)]
+        run_terminal = tail_succ == run_tail
+        rid_of = lambda x: rid[jnp.minimum(x, T - 1)]  # noqa: E731
+        run_next = jnp.where(run_terminal, rid_of(run_tail),
+                             rid_of(tail_succ))
+        run_s_c = jnp.minimum(run_s_w, M)
+        run_e1_c = jnp.minimum(run_e_w + 1, M)
+        # per-run total weight; zero-weight absorbing (terminal) runs
+        # make the Wyllie telescoping exact once pointers collapse
+        a0 = jnp.where(run_terminal, 0, cse_doc[run_e1_c] - cse_doc[run_s_c])
+        b0 = jnp.where(run_terminal, 0, cse_vis[run_e1_c] - cse_vis[run_s_c])
+
         def wy_cond(state):
             _, _, _, live, i = state
-            return live & (i < cap)
+            return live & (i < _ceil_log2(w) + 1)
 
         def wy_body(state):
             a, b, p, _, i = state
@@ -797,60 +811,43 @@ def _materialize(ops: Dict[str, jax.Array],
             p2 = p[p]
             return a2, b2, p2, jnp.any(p2 != p), i + 1
 
-        a, b, _, _, _ = lax.while_loop(
-            wy_cond, wy_body, (a, b, p, jnp.array(True), jnp.int32(0)))
-        return a, b
+        a_doc, a_vis, _, _, _ = lax.while_loop(
+            wy_cond, wy_body,
+            (a0, b0, jnp.minimum(run_next, w - 1), jnp.array(True),
+             jnp.int32(0)))
+        # rid[:M] < M, so the value plane never needs more than the
+        # first M runs — slice full-width (w = 2M) fallback sources down
+        out = min(w, M)
+        per_run = jnp.stack([
+            run_fwd[:out].astype(jnp.int32),
+            cse_doc[run_s_c[:out]], cse_doc[run_e1_c[:out]], a_doc[:out],
+            cse_vis[run_s_c[:out]], cse_vis[run_e1_c[:out]], a_vis[:out],
+        ])
+        return mono_gather.monotone_gather(per_run, rid[:M],
+                                           use_pallas=use_pallas)
 
-    # Per-run data live in the first #runs entries of T-length arrays.  On
-    # real logs #runs << T (insertion chains contract to a handful of runs
-    # each), so the doubling loop — whose trips gather full-width — runs
-    # at a small static width R_CAP whenever the run count fits, falling
-    # back to full width for adversarially fragmented tours (comb-shaped
-    # logs where every token is its own run).  Saves ~10 full-width
-    # gather rounds over 2M tokens at the 1M-op headline.
-    a0, b0 = run_sum(cse_doc), run_sum(cse_vis)
+    # Per-run data live in the first #runs entries.  On real logs
+    # #runs << T (insertion chains contract to a handful of runs each),
+    # so the whole per-run pipeline — derivation gathers, the doubling
+    # loop, the expansion-source build, and the monotone gather's value
+    # plane — runs at a small static width R_CAP whenever the run count
+    # fits, falling back to full width for adversarially fragmented
+    # tours (comb-shaped logs where every token is its own run).  Both
+    # branches produce the same [7, M] expansion.
     R_CAP = 1 << 15
     if R_CAP >= T:
-        a_doc, a_vis = _wyllie(a0, b0, run_next, _ceil_log2(T) + 1)
+        ex = _expand(run_s, run_e)
     else:
         n_runs = rid[T - 1] + 1
+        ex = lax.cond(
+            n_runs <= R_CAP,
+            lambda _: _expand(run_s[:R_CAP], run_e[:R_CAP]),
+            lambda _: _expand(run_s, run_e), None)
 
-        def br_small(args):
-            a, b, p = args
-            a_s, b_s = _wyllie(a[:R_CAP], b[:R_CAP],
-                               jnp.minimum(p[:R_CAP], R_CAP - 1),
-                               _ceil_log2(R_CAP) + 1)
-            pad = jnp.zeros(T - R_CAP, jnp.int32)
-            return (jnp.concatenate([a_s, pad]),
-                    jnp.concatenate([b_s, pad]))
-
-        def br_full(args):
-            a, b, p = args
-            return _wyllie(a, b, p, _ceil_log2(T) + 1)
-
-        a_doc, a_vis = lax.cond(n_runs <= R_CAP, br_small, br_full,
-                                (a0, b0, run_next))
-
-    # E(tok) = weight at-or-after tok along the chain; within-run offsets
-    # from the global cumsum (forward runs count from the run start,
-    # backward runs toward it).
-    # Expand per-run values back to tokens.  Ranks are read only at ENTER
-    # tokens (rank(v) needs e_tok at enter(v), tokens 0..M-1), so the
-    # expansion and the rank arithmetic run at M width — half the tour.
-    # These are the kernel's monotone-bounded gathers (rid is
-    # nondecreasing with increments ≤ 1), served by the pallas kernel on
-    # TPU — one DMA-tiled pass for all seven rows instead of seven
-    # generic M-wide XLA gathers.
-    # rid[:M] < M (rid climbs by ≤ 1 from 0), so the expansion sources
-    # slice to the first M runs too — the input build matches the
-    # half-width output
-    per_run = jnp.stack([
-        run_fwd[:M].astype(jnp.int32),
-        cse_doc[run_s_c[:M]], cse_doc[run_e1_c[:M]], a_doc[:M],
-        cse_vis[run_s_c[:M]], cse_vis[run_e1_c[:M]], a_vis[:M],
-    ])
-    ex = mono_gather.monotone_gather(per_run, rid[:M],
-                                     use_pallas=use_pallas)
+    # E(tok) = weight at-or-after tok along the chain; within-run
+    # offsets from the global cumsum (forward runs count from the run
+    # start, backward runs toward it); ranks then read at ENTER tokens
+    # (tokens 0..M-1) — half the tour.
     rf_m = ex[0].astype(bool)
 
     def rank_of(ws_m, we1_m, a_m, cse):
