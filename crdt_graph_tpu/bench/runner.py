@@ -20,6 +20,7 @@ from typing import Dict, Iterable, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ..codec import packed as packed_mod
 from ..ops import merge
@@ -34,27 +35,40 @@ def _as_arrays(workload) -> Dict[str, np.ndarray]:
 
 def _summary_fn():
     """Jitted merge returning only small dependent outputs: a fingerprint
-    over the order-defining fields plus the node/visible counts.  One
-    dispatch, one tiny readback."""
-    def fn(ops):
+    over the order-defining fields plus the node/visible counts — and,
+    when an expected sequence rides along (call arity specializes the jit
+    trace), an order-exactness flag fused into the same compile: a second
+    full-kernel jit for the order check alone costs minutes of TPU
+    compile time.  One dispatch, one tiny readback."""
+    def fn(ops, *expected):
         t = merge._materialize(ops)
         fp = honest.fingerprint(
             (t.doc_index, t.visible_order, t.status, t.ts))
-        return fp, t.num_nodes, t.num_visible
+        if expected:
+            exp = expected[0]
+            seq = t.ts[t.visible_order]
+            ok = jnp.all(seq[:exp.shape[0]] == exp) & \
+                (t.num_visible == exp.shape[0])
+        else:
+            ok = jnp.bool_(True)
+        return fp, t.num_nodes, t.num_visible, ok
 
     if jax.config.jax_enable_x64:
         return jax.jit(fn)
     jitted = jax.jit(fn)
 
-    def wrapped(ops):
+    def wrapped(ops, *expected):
         with jax.enable_x64(True):
-            return jitted(ops)
+            return jitted(ops, *expected)
     return wrapped
 
 
 def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
-               progress: bool = False, audit: bool = True) -> dict:
-    """Compile, warm up, and honestly time the jitted merge."""
+               progress: bool = False, audit: bool = True,
+               expected_ts: Optional[np.ndarray] = None) -> dict:
+    """Compile, warm up, and honestly time the jitted merge.  With
+    ``expected_ts``, every repeat also checks the full visible sequence
+    against it on device (``order_exact`` in the result)."""
     def _log(msg: str) -> None:
         if progress:
             print(f"bench: {msg}", file=sys.stderr, flush=True)
@@ -62,8 +76,10 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
     dev_ops = jax.device_put(ops)
     _log("arrays on device")
     fn = _summary_fn()
-    stats = honest.time_with_readback(fn, dev_ops, repeats=repeats, log=_log)
-    _, num_nodes, num_visible = stats["last_result"]
+    args = (dev_ops,) if expected_ts is None else \
+        (dev_ops, jax.device_put(expected_ts))
+    stats = honest.time_with_readback(fn, *args, repeats=repeats, log=_log)
+    _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
     p50_s = stats["p50_ms"] / 1e3
     out = {
@@ -75,9 +91,11 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         "num_visible": int(num_visible),
         "dispatch_overhead_ms": honest.overhead_floor_ms(),
     }
+    if expected_ts is not None:
+        out["order_exact"] = bool(order_ok)
     if audit:
         out["audit"] = honest.audit_async_gap(
-            fn, dev_ops, expected_s=p50_s, log=_log)
+            fn, *args, expected_s=p50_s, log=_log)
     return out
 
 
