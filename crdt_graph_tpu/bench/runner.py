@@ -73,11 +73,15 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         if progress:
             print(f"bench: {msg}", file=sys.stderr, flush=True)
 
-    dev_ops = jax.device_put(ops)
+    # device_put must sit inside an x64 scope: outside it JAX silently
+    # truncates the int64 timestamps to int32 (the mesh.py footgun) and
+    # both the merge input and the expected sequence would be garbage
+    with jax.enable_x64(True):
+        dev_ops = jax.device_put(ops)
+        args = (dev_ops,) if expected_ts is None else \
+            (dev_ops, jax.device_put(expected_ts))
     _log("arrays on device")
     fn = _summary_fn()
-    args = (dev_ops,) if expected_ts is None else \
-        (dev_ops, jax.device_put(expected_ts))
     stats = honest.time_with_readback(fn, *args, repeats=repeats, log=_log)
     _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))

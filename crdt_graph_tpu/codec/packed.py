@@ -101,6 +101,13 @@ class PackedOps:
     target_pos: Optional[np.ndarray] = None
     # rank hint (see module docstring); default -1 = device-sort fallback
     ts_rank: Optional[np.ndarray] = None
+    # provenance: True when the LINK hint columns are known-complete
+    # (every in-batch reference resolved) because this object came from
+    # pack/concat/parse_pack.  Callers may then use the kernel's
+    # cond-free "exhaustive" mode; objects with defaulted hint columns
+    # (e.g. restored old checkpoints) must keep the verified auto mode.
+    # ts_rank needs no flag — post_init computes it from kind/ts.
+    hints_vouched: bool = False
     # host-side ts -> first add position index, cached so engine concat
     # chains don't rebuild it per bulk apply (not a device field)
     ts_index: Optional[dict] = dataclasses.field(default=None, repr=False)
@@ -270,7 +277,8 @@ def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
                      anchor_ts=anchor_ts, depth=depth, paths=paths,
                      value_ref=value_ref, pos=pos, values=values, num_ops=n,
                      parent_pos=parent_pos, anchor_pos=anchor_pos,
-                     target_pos=target_pos, ts_index=first)
+                     target_pos=target_pos, ts_index=first,
+                     hints_vouched=True)
 
 
 def unpack(packed: PackedOps) -> List[Operation]:
@@ -354,8 +362,11 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     out.ts_index = dict(a_index)
     for t, i in b_index.items():
         out.ts_index.setdefault(t, i + na)
-    # rank hints cover the union (post_init saw only padding rows)
+    # rank hints cover the union (post_init saw only padding rows); the
+    # cross-fill above preserves link-hint completeness only if both
+    # sides had it
     out.ts_rank = compute_ts_rank(out.kind, out.ts)
+    out.hints_vouched = a.hints_vouched and b.hints_vouched
     return out
 
 

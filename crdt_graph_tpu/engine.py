@@ -51,6 +51,16 @@ from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
 DELTA_THRESHOLD = 256
 
 
+def _mode(p: PackedOps) -> Optional[str]:
+    """Kernel hint mode for a packed batch: the cond-free "exhaustive"
+    path when this engine's own ingest vouched for hint completeness
+    (pack/concat/parse_pack provenance — auto and exhaustive are then
+    semantically identical, and exhaustive compiles neither the sort nor
+    the join); verified auto otherwise (e.g. restored checkpoints whose
+    hint columns were defaulted)."""
+    return "exhaustive" if p.hints_vouched else None
+
+
 class StaleNodeView(RuntimeError):
     """A TableNode outlived the state it points into.
 
@@ -297,7 +307,8 @@ class TpuTree:
             self._packed = packed_mod.pack(self._log,
                                            max_depth=self._max_depth)
             self._table = view_mod.to_host(
-                merge_mod.materialize(self._packed.arrays()))
+                merge_mod.materialize(self._packed.arrays(),
+                                      hints=_mode(self._packed)))
         return self._table
 
     def _ensure_mirror(self) -> HostTree:
@@ -429,7 +440,8 @@ class TpuTree:
         p = packed_mod.concat(self._ensure_packed(),
                               packed_mod.pack(leaves,
                                               max_depth=self._max_depth))
-        table = view_mod.to_host(merge_mod.materialize(p.arrays()))
+        table = view_mod.to_host(merge_mod.materialize(p.arrays(),
+                                                       hints=_mode(p)))
         n0 = len(self._log)
         st = np.asarray(table.status)[n0:n0 + len(leaves)]
         failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
@@ -754,6 +766,7 @@ class TpuTree:
             "max_depth": self._max_depth,
             "num_ops": p.num_ops,
             "last_operation": json_codec.encode(self._last_operation),
+            "hints_vouched": p.hints_vouched,
         }
         with open(path, "wb") as f:
             np.savez_compressed(
@@ -782,7 +795,11 @@ class TpuTree:
             # and the kernel's join fallback keeps semantics
             parent_pos=z["parent_pos"] if "parent_pos" in z.files else None,
             anchor_pos=z["anchor_pos"] if "anchor_pos" in z.files else None,
-            target_pos=z["target_pos"] if "target_pos" in z.files else None)
+            target_pos=z["target_pos"] if "target_pos" in z.files else None,
+            # provenance survives the round trip: a vouched writer's
+            # complete hint columns keep restored trees on the cond-free
+            # exhaustive path; absent meta (old files) stays unvouched
+            hints_vouched=bool(meta.get("hints_vouched", False)))
         tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
         tree._log = packed_mod.unpack(p)
         tree._packed = p

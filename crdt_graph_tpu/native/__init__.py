@@ -112,7 +112,8 @@ def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
         num_ops=n,
         parent_pos=_padded(col("parent_pos", np.int32), cap, fill=-1),
         anchor_pos=_padded(col("anchor_pos", np.int32), cap, fill=-1),
-        target_pos=_padded(col("target_pos", np.int32), cap, fill=-1))
+        target_pos=_padded(col("target_pos", np.int32), cap, fill=-1),
+        hints_vouched=True)   # the C++ parser resolves every in-batch ref
     return out
 
 

@@ -231,3 +231,41 @@ def test_set_cursor_rejects_dead_nodes_like_oracle():
         t.set_cursor((1, 2))
     with pytest.raises(crdt.NotFound):
         o.set_cursor((1, 2))
+
+
+def test_hint_provenance_gates_exhaustive_mode():
+    """pack/concat/parse_pack vouch for link-hint completeness (the
+    engine may then use the cond-free exhaustive kernel mode); a
+    PackedOps whose hint columns were DEFAULTED — an old checkpoint —
+    must stay on the verified auto path, where the join resolves what
+    the missing hints cannot (engine._mode)."""
+    from crdt_graph_tpu.codec import packed as packed_mod
+    from crdt_graph_tpu.engine import _mode
+
+    ops = [Add(1, (0,), "a"), Add(2, (1,), "b"), Delete((1,))]
+    p = packed_mod.pack(ops)
+    assert p.hints_vouched and _mode(p) == "exhaustive"
+    u = packed_mod.concat(p, packed_mod.pack([Add(3, (2,), "c")]))
+    assert u.hints_vouched and _mode(u) == "exhaustive"
+    # strip provenance the way an old npz restore does: defaulted columns
+    bare = packed_mod.PackedOps(
+        kind=p.kind, ts=p.ts, parent_ts=p.parent_ts, anchor_ts=p.anchor_ts,
+        depth=p.depth, paths=p.paths, value_ref=p.value_ref, pos=p.pos,
+        values=list(p.values), num_ops=p.num_ops)
+    assert not bare.hints_vouched and _mode(bare) is None
+    # and the auto path still merges it correctly via the join
+    from crdt_graph_tpu.ops import merge as merge_mod
+    from crdt_graph_tpu.ops import view as view_mod
+    t = view_mod.to_host(merge_mod.materialize(bare.arrays()))
+    assert view_mod.visible_values(t, bare.values) == ["b"]
+
+
+def test_checkpoint_roundtrip_preserves_hint_provenance(tmp_path):
+    t = engine.init(4)
+    t.add("a")
+    t.add("b")
+    path = str(tmp_path / "ck.npz")
+    t.checkpoint_packed(path)
+    r = engine.TpuTree.restore_packed(path)
+    assert r._packed.hints_vouched
+    assert r.visible_values() == t.visible_values()
