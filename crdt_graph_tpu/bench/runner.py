@@ -33,15 +33,16 @@ def _as_arrays(workload) -> Dict[str, np.ndarray]:
     return packed_mod.pack(workload).arrays()
 
 
-def _summary_fn():
+def _summary_fn(no_deletes: bool = False):
     """Jitted merge returning only small dependent outputs: a fingerprint
     over the order-defining fields plus the node/visible counts — and,
     when an expected sequence rides along (call arity specializes the jit
     trace), an order-exactness flag fused into the same compile: a second
     full-kernel jit for the order check alone costs minutes of TPU
-    compile time.  One dispatch, one tiny readback."""
+    compile time.  One dispatch, one tiny readback.  ``no_deletes`` is
+    the host-checked static promise from time_merge."""
     def fn(ops, *expected):
-        t = merge._materialize(ops)
+        t = merge._materialize(ops, no_deletes=no_deletes)
         fp = honest.fingerprint(
             (t.doc_index, t.visible_order, t.status, t.ts))
         if expected:
@@ -81,7 +82,8 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         args = (dev_ops,) if expected_ts is None else \
             (dev_ops, jax.device_put(expected_ts))
     _log("arrays on device")
-    fn = _summary_fn()
+    fn = _summary_fn(no_deletes=merge.host_no_deletes(
+        np.asarray(ops["kind"])))
     stats = honest.time_with_readback(fn, *args, repeats=repeats, log=_log)
     _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))

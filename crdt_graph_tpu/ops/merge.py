@@ -101,6 +101,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
@@ -209,10 +210,11 @@ def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
     return val
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _materialize(ops: Dict[str, jax.Array],
                  use_pallas: Optional[bool] = None,
-                 hints: Optional[str] = None) -> NodeTable:
+                 hints: Optional[str] = None,
+                 no_deletes: bool = False) -> NodeTable:
     """``use_pallas``: pallas usage for the rank-expansion gathers
     (ops/mono_gather.py).  None = auto (Mosaic kernel on TPU backends,
     lax elsewhere); wrappers whose transforms the pallas call must not
@@ -226,7 +228,15 @@ def _materialize(ops: Dict[str, jax.Array],
     compile the hinted path ONLY — no cond, so the trace is vmappable
     and partitionable and the join never compiles; "join" = ignore
     hints entirely.  Results are identical across modes for batches
-    with exhaustive hints (pinned by tests)."""
+    with exhaustive hints (pinned by tests).
+
+    ``no_deletes``: static promise that no row is a Delete (the caller
+    checked the kind column host-side — ``materialize`` does this
+    automatically for numpy inputs).  Skips the tombstone/dead-subtree
+    machinery (steps 7-8) and the delete statuses at trace time — the
+    common all-adds serving batch compiles and runs leaner.  A violated
+    promise would silently ignore deletes, so only host-checked callers
+    set it."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -562,25 +572,34 @@ def _materialize(ops: Dict[str, jax.Array],
     # ---- 7. Deletes: tombstone valid targets (first delete per target wins
     # the log; the tree flag is an idempotent OR either way).  Target match
     # checks the full claimed path exactly against the target's
-    # materialised path.
-    d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
-    d_path_ok = jnp.all(
-        jnp.where(cols < depth[:, None], paths == fp[d_tslot], True), axis=1)
-    d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
-        d_depth_ok & d_path_ok
-    d_tgt = jnp.where(d_ok, d_tslot, NULL)
-    deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
-    del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
-        .at[NULL].set(IPOS)
+    # materialised path.  Under the static no-deletes promise the whole
+    # tombstone/dead machinery drops out of the trace.
+    if no_deletes:
+        # only these three escape the delete-guarded blocks
+        deleted = jnp.zeros(M, bool)
+        anc_del = jnp.full(M, IPOS, jnp.int32)
+        dead = jnp.zeros(M, bool)
+    else:
+        d_depth_ok = (depth >= 1) & (depth <= D) & \
+            (node_depth[d_tslot] == depth)
+        d_path_ok = jnp.all(
+            jnp.where(cols < depth[:, None], paths == fp[d_tslot], True),
+            axis=1)
+        d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
+            d_depth_ok & d_path_ok
+        d_tgt = jnp.where(d_ok, d_tslot, NULL)
+        deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
+        del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
+            .at[NULL].set(IPOS)
 
-    # ---- 8. Dead-subtree propagation down tree-parent chains (delete
-    # discards descendants, Internal/Node.elm:237-238).  Also carries the
-    # earliest ancestor-delete position for absorption statuses.  Skipped
-    # when the batch has no effective delete.
-    anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
-    anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
-                       _ceil_log2(D) + 1)
-    dead = valid & (anc_del < IPOS)
+        # ---- 8. Dead-subtree propagation down tree-parent chains (delete
+        # discards descendants, Internal/Node.elm:237-238).  Also carries
+        # the earliest ancestor-delete position for absorption statuses.
+        # Skipped when the batch has no effective delete.
+        anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
+        anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
+                           _ceil_log2(D) + 1)
+        dead = valid & (anc_del < IPOS)
 
     # ---- 9. The order forest: each node's T* parent is the nearest node on
     # its within-branch anchor chain with a SMALLER timestamp (-1 = chain
@@ -890,21 +909,23 @@ def _materialize(ops: Dict[str, jax.Array],
                   jnp.where(a_parent_ok & a_grandvalid, NOT_FOUND,
                             INVALID_PATH)))
     status = jnp.where(is_add, a_status.astype(jnp.int8), status)
-    # deletes
-    d_parent_ok = (depth == 1) | \
-        ((depth >= 2) & dp_found & ((meta[dp_slot] & 1) != 0))
-    d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
-    d_repeat = d_ok & (del_pos[d_tslot] < pos)
-    d_target_later = d_ok & (node_pos[d_tslot] > pos)
-    # deleting a branch-head sentinel (ts 0) finds a tombstone: AlreadyApplied
-    d_sentinel = (ts == 0) & d_parent_ok
-    d_status = jnp.where(
-        d_sentinel | d_anc_absorbed | (d_repeat & ~d_target_later),
-        ALREADY_APPLIED,
-        jnp.where(d_ok & ~d_target_later, APPLIED,
-                  jnp.where(d_target_later | d_parent_ok, NOT_FOUND,
-                            INVALID_PATH)))
-    status = jnp.where(is_del, d_status.astype(jnp.int8), status)
+    # deletes (statically absent under the no-deletes promise)
+    if not no_deletes:
+        d_parent_ok = (depth == 1) | \
+            ((depth >= 2) & dp_found & ((meta[dp_slot] & 1) != 0))
+        d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
+        d_repeat = d_ok & (del_pos[d_tslot] < pos)
+        d_target_later = d_ok & (node_pos[d_tslot] > pos)
+        # deleting a branch-head sentinel (ts 0) finds a tombstone:
+        # AlreadyApplied
+        d_sentinel = (ts == 0) & d_parent_ok
+        d_status = jnp.where(
+            d_sentinel | d_anc_absorbed | (d_repeat & ~d_target_later),
+            ALREADY_APPLIED,
+            jnp.where(d_ok & ~d_target_later, APPLIED,
+                      jnp.where(d_target_later | d_parent_ok, NOT_FOUND,
+                                INVALID_PATH)))
+        status = jnp.where(is_del, d_status.astype(jnp.int8), status)
 
     return NodeTable(
         ts=node_ts, parent=parent_eff, depth=node_depth,
@@ -916,17 +937,31 @@ def _materialize(ops: Dict[str, jax.Array],
         status=status)
 
 
+def host_no_deletes(kind) -> bool:
+    """Host-side check backing the kernel's static no-deletes promise —
+    the single definition of "this batch has no delete-like rows"; every
+    caller that sets the static flag must use it (a violated promise
+    silently drops deletes).  Only a host-resident column can be checked
+    without a device sync; anything else conservatively returns False."""
+    return isinstance(kind, np.ndarray) and \
+        not bool(np.any(kind == KIND_DELETE))
+
+
 def materialize(ops: Dict[str, jax.Array],
                 use_pallas: Optional[bool] = None,
                 hints: Optional[str] = None) -> NodeTable:
     """ops arrays (see codec.packed.PackedOps.arrays) → NodeTable.
+
+    Host-resident kind columns are checked once so all-adds batches take
+    the leaner static no-deletes trace (see ``_materialize``).
 
     Timestamps are int64, so the kernel requires 64-bit mode; if the host
     program runs JAX in default x32 mode, tracing and input conversion are
     scoped inside ``jax.enable_x64`` rather than flipping the process-global
     flag.
     """
+    no_deletes = host_no_deletes(ops.get("kind"))
     if jax.config.jax_enable_x64:
-        return _materialize(ops, use_pallas, hints)
+        return _materialize(ops, use_pallas, hints, no_deletes)
     with jax.enable_x64(True):
-        return _materialize(ops, use_pallas, hints)
+        return _materialize(ops, use_pallas, hints, no_deletes)

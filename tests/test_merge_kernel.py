@@ -433,3 +433,28 @@ def test_forged_prefix_rejected_exactly():
     assert vis == ["a", "b"]
     assert view.statuses(t, p.num_ops)[2:] == \
         ["invalid_path", "invalid_path"]
+
+
+def test_no_deletes_trace_parity():
+    """The static no-deletes fast path must be bit-identical to the
+    default trace on an all-adds batch, and materialize must keep it OFF
+    the moment a delete is present (merge.host_no_deletes is the single
+    definition both call sites share)."""
+    merged, ops = _random_session(17, n_replicas=3, steps=50)
+    ops = [op for op in ops if not isinstance(op, Delete)]  # all-adds
+    p = packed.pack(ops)
+    arrs = p.arrays()
+    assert merge.host_no_deletes(arrs["kind"])
+    import jax
+    with jax.enable_x64(True):
+        lean = view.to_host(merge._materialize(arrs, None, None, True))
+        full = view.to_host(merge._materialize(arrs, None, None, False))
+    for f in ("ts", "parent", "depth", "value_ref", "exists", "tombstone",
+              "dead", "visible", "doc_index", "order", "visible_order",
+              "status"):
+        assert np.array_equal(np.asarray(getattr(lean, f)),
+                              np.asarray(getattr(full, f))), f
+    # a single delete flips the host check off
+    with_del = ops + [Delete(ops[0].path[:0] + (ops[0].ts,))]
+    p2 = packed.pack(with_del)
+    assert not merge.host_no_deletes(p2.arrays()["kind"])
