@@ -1,12 +1,14 @@
 """One-session TPU measurement: everything we need from a single tunnel
 grant, serially (two clients deadlock the tunnel — see bench.py).
 
-Phases (each prints one JSON line to stdout; progress to stderr):
+Phases (each prints one JSON line to stdout; progress to stderr; a
+phase failure records an error line and later phases still run):
+0. cheap pallas live-chip check (Mosaic kernel exactness + small merge)
 1. trivial dispatch + overhead floor
 2. headline 1M merge: honest timing + async-gap audit + closed-form
-   order check
+   order check fused into the timed kernel
 3. pallas rank-gather A/B: use_pallas True vs False (static-arg variants)
-4. 8-config sweep with full-sequence order checks
+4. 8-config sweep with fused full-sequence order checks
 5. scale sweep 250k-2M
 
 Usage: python scripts/tpu_session.py [phases…]   (default: 1 2 3)
@@ -53,13 +55,39 @@ def phase2():
     out({"phase": 2, "headline_1M": stats})
 
 
+def phase0():
+    """Cheap live-chip pallas compile/exactness check before anything
+    expensive: the Mosaic kernel in isolation, then a small full merge
+    with the pallas path pinned on."""
+    import numpy as np
+
+    from crdt_graph_tpu.ops import mono_gather, view
+
+    rng = np.random.default_rng(0)
+    inc = rng.integers(0, 2, 50_000)
+    inc[0] = 0
+    rid = np.cumsum(inc).astype(np.int32)
+    vals = rng.integers(0, 1 << 23, (7, rid[-1] + 1)).astype(np.int32)
+    got = np.asarray(jax.jit(
+        lambda v, r: mono_gather.monotone_gather(v, r, use_pallas=True)
+    )(vals, rid))
+    kernel_ok = bool(np.array_equal(got, vals[:, rid]))
+    ops = workloads.chain_workload(8, 20_000)
+    t = view.to_host(merge.materialize(ops, use_pallas=True))
+    seq = np.asarray(t.ts)[np.asarray(t.visible_order)[:int(t.num_visible)]]
+    merge_ok = bool(np.array_equal(
+        seq, workloads.chain_expected_ts(8, 20_000)))
+    out({"phase": 0, "pallas_kernel_exact": kernel_ok,
+         "small_merge_pallas_exact": merge_ok})
+
+
 def phase3():
     ops = workloads.chain_workload(64, 1_000_000)
     dev_ops = jax.device_put(ops)
 
     def timed(flag):
         def fn(o):
-            t = merge._materialize(o, flag)
+            t = merge._materialize(o, flag, None, True)
             return honest.fingerprint((t.doc_index, t.num_visible))
         s = honest.time_with_readback(fn, dev_ops, repeats=3, log=log)
         s.pop("last_result", None)
@@ -90,4 +118,8 @@ if __name__ == "__main__":
     phases = [int(a) for a in sys.argv[1:]] or [1, 2, 3]
     for p in phases:
         log(f"=== phase {p} ===")
-        globals()[f"phase{p}"]()
+        try:
+            globals()[f"phase{p}"]()
+        except Exception as e:     # keep later phases alive; record it
+            log(f"phase {p} FAILED: {e!r}")
+            out({"phase": p, "error": repr(e)[:500]})
