@@ -47,16 +47,23 @@ def fingerprint(tree: Any) -> jax.Array:
     Call inside jit so the checksum rides the same dispatch as the
     computation; reading back the resulting scalar then forces the whole
     graph.  Cost: one pass of cheap reductions, negligible next to the
-    computation being timed.
+    computation being timed — int64 leaves fold as two int32 halves
+    (v5e emulates 64-bit arithmetic; a wide modulo would bill the
+    HARNESS, not the kernel, for emulation cost).
     """
+    split64 = jax.default_backend() == "tpu"   # CPU modulo is native/fast
     s = jnp.int64(0)
     for leaf in jax.tree_util.tree_leaves(tree):
         a = jnp.asarray(leaf)
-        if a.dtype == jnp.bool_:
+        if not jnp.issubdtype(a.dtype, jnp.integer):   # bool/float/...
             a = a.astype(jnp.int32)
-        elif not jnp.issubdtype(a.dtype, jnp.integer):
-            a = a.astype(jnp.int32)
-        s = s + jnp.sum(a.astype(jnp.int64) % jnp.int64(1000003))
+        if a.dtype == jnp.int64 and split64:
+            halves = ((a >> 32).astype(jnp.int32),
+                      a.astype(jnp.uint32).astype(jnp.int32))
+        else:
+            halves = (a,)
+        for h in halves:
+            s = s + jnp.sum((h % 1000003).astype(jnp.int64))
     return s
 
 

@@ -83,11 +83,12 @@ def phase0():
 
 def phase3():
     ops = workloads.chain_workload(64, 1_000_000)
+    no_del = merge.host_no_deletes(ops["kind"])   # host-checked promise
     dev_ops = jax.device_put(ops)
 
     def timed(flag):
         def fn(o):
-            t = merge._materialize(o, flag, None, True)
+            t = merge._materialize(o, flag, None, no_del)
             return honest.fingerprint((t.doc_index, t.num_visible))
         s = honest.time_with_readback(fn, dev_ops, repeats=3, log=log)
         s.pop("last_result", None)
@@ -116,10 +117,11 @@ def phase5():
 
 if __name__ == "__main__":
     phases = [int(a) for a in sys.argv[1:]] or [1, 2, 3]
-    for p in phases:
+    fns = [globals()[f"phase{p}"] for p in phases]   # typos fail fast
+    for p, fn in zip(phases, fns):
         log(f"=== phase {p} ===")
         try:
-            globals()[f"phase{p}"]()
+            fn()
         except Exception as e:     # keep later phases alive; record it
             log(f"phase {p} FAILED: {e!r}")
             out({"phase": p, "error": repr(e)[:500]})
