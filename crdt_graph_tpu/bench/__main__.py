@@ -1,3 +1,4 @@
+import os
 import sys
 
 import jax
@@ -6,6 +7,11 @@ from ..utils import compcache
 
 compcache.enable()
 jax.config.update("jax_enable_x64", True)
+if os.environ.get("GRAFT_CPU") == "1":
+    # pin before any device use: on a wedged TPU tunnel the first
+    # dispatch hangs forever, and JAX_PLATFORMS alone is not enough
+    # (the axon sitecustomize re-registers the TPU plugin)
+    jax.config.update("jax_platforms", "cpu")
 
 from .runner import main  # noqa: E402
 
