@@ -263,16 +263,16 @@ def _materialize(ops: Dict[str, jax.Array],
     # ---- 2. Column index row, shared by the masked path compares below.
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
 
-    # ---- 1-4. Slot assignment and timestamp→slot resolution.  Three
-    # interchangeable constructions of one interface (the 17-tuple below);
-    # all downstream stages are path-agnostic.
+    # ---- 1-4. Slot assignment and timestamp→slot resolution.  Two
+    # interchangeable constructions of one interface (the 11-tuple
+    # described below); all downstream stages are path-agnostic.
     #
     # SORTED+JOIN (always available): one stable (hi, lo) int32 key sort
     # of the add timestamps assigns dense slots (slot order IS timestamp
     # order; first array row wins duplicates — producers keep ``pos ==
-    # array index``, codec/packed.py), then a sort-merge join resolves all
-    # 2M+2N timestamp references (method="sort": the per-query binary
-    # search was 1.67 s device time at 1M ops on v5e).
+    # array index``, codec/packed.py), then a per-op sort-merge join
+    # resolves the 3N timestamp references (method="sort": the per-query
+    # binary search was 1.67 s device time at 1M ops on v5e).
     #
     # RANKED+HINTED (ingest hints): ``ts_rank`` assigns slots directly
     # (slot = rank+1, canonical copy = min batch pos per slot, one
@@ -289,9 +289,18 @@ def _materialize(ops: Dict[str, jax.Array],
     # caller VOUCHES for hint coverage (pack/concat provenance) and the
     # sort/join never compile — a violated promise there silently
     # mis-resolves, which is why the mode is opt-in per call site.
-    def _sorted_core():
-        """Steps 1+3, sort-based: the 9 table arrays plus what the join
-        needs (sorted_ts and the canonical scatter)."""
+    # Branch interface — everything per-op (N-wide) except the three node
+    # arrays the rank verification shares (node_ts, node_pos,
+    # is_node_slot); the rest of the node table is constructed ONCE after
+    # selection, so the auto-mode lax.cond never carries the [M, D] path
+    # plane or the resolution scatters as operands:
+    #   (op_slot, op_is_dup, node_ts, node_pos, is_node_slot,
+    #    pp_slot, aa_slot, tt_slot, pp_found, aa_found, tt_found)
+    # The delete-parent resolution is the per-op parent resolution
+    # (dp ≡ pp), so it needs no slots of its own.
+    def _sorted_slots():
+        """Sort-based slot assignment: the first five tuple entries plus
+        the sorted timestamp axis the join needs."""
         sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
         ts_hi, ts_lo = _split_ts(sort_ts)
         # stable sort: equal timestamps keep batch order; pos re-derives
@@ -317,38 +326,24 @@ def _materialize(ops: Dict[str, jax.Array],
             jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
         op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
             ~run_start & not_big, unique_indices=True)
-
-        # Scatter canonical adds into the node table (slots 1..N).
-        # Non-canonical rows aim out of range (M) and drop.
         tgt = jnp.where(is_canon, slot_of_sorted, M)
-
-        def scat(init, vals):
-            return init.at[tgt].set(vals, mode="drop", unique_indices=True)
-
-        g = lambda a: a[sorted_idx]  # noqa: E731  original-order, sorted
-        node_ts = scat(jnp.full(M, BIG, jnp.int64), sorted_ts) \
+        node_ts = jnp.full(M, BIG, jnp.int64).at[tgt].set(
+            sorted_ts, mode="drop", unique_indices=True) \
             .at[ROOT].set(0).at[NULL].set(BIG)
-        node_depth = scat(jnp.zeros(M, jnp.int32), g(depth)).at[ROOT].set(0)
-        node_value_ref = scat(jnp.full(M, -1, jnp.int32), g(value_ref))
-        node_pos = scat(jnp.full(M, IPOS, jnp.int32), sorted_pos)
-        node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt].set(
-            paths[sorted_idx], mode="drop", unique_indices=True)
-        is_node_slot = scat(jnp.zeros(M, bool), is_canon)
-        node_anchor_sent = scat(jnp.zeros(M, bool), g(anchor_ts == 0))
-        tables = (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
-                  node_pos, node_claimed, is_node_slot, node_anchor_sent)
-        return tables, sorted_ts, scat, g
+        node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
+            sorted_pos, mode="drop", unique_indices=True)
+        is_node_slot = jnp.zeros(M, bool).at[tgt].set(
+            is_canon, mode="drop", unique_indices=True)
+        return (op_slot, op_is_dup, node_ts, node_pos,
+                is_node_slot), sorted_ts
 
-    def _joined_from(core):
-        """Sort-merge join of all 2M+2N timestamp references against the
-        sorted add axis (closes over the core's sorted_ts/scatter)."""
-        _, sorted_ts, scat, g = core
-        queries = jnp.concatenate([
-            scat(jnp.zeros(M, jnp.int64), g(parent_ts)),   # node parent ts
-            scat(jnp.zeros(M, jnp.int64), g(anchor_ts)),   # node anchor ts
-            ts,                                            # delete target
-            parent_ts,                                     # delete parent
-        ])
+    def _join_ops(sorted_ts):
+        """Per-op sort-merge join (3N queries: parent, anchor, own-ts
+        against the sorted add axis; method="sort": the per-query binary
+        search was 1.67 s device time at 1M ops on v5e).  Kept in its
+        own function so hint-verified merges can defer it into a cond
+        branch that never executes."""
+        queries = jnp.concatenate([parent_ts, anchor_ts, ts])
         qidx = jnp.searchsorted(sorted_ts, queries, side="left",
                                 method="sort").astype(jnp.int32)
         qidx_c = jnp.minimum(qidx, N - 1)
@@ -358,14 +353,12 @@ def _materialize(ops: Dict[str, jax.Array],
                           jnp.where(qhit, qidx_c + 1, NULL)) \
             .astype(jnp.int32)
         qfound = (queries == 0) | qhit
-        return (qslot[:M], qslot[M:2 * M],
-                qslot[2 * M:2 * M + N], qslot[2 * M + N:],
-                qfound[:M], qfound[M:2 * M],
-                qfound[2 * M:2 * M + N], qfound[2 * M + N:])
+        return (qslot[:N], qslot[N:2 * N], qslot[2 * N:],
+                qfound[:N], qfound[N:2 * N], qfound[2 * N:])
 
-    def _build_sorted_joined(_):
-        core = _sorted_core()
-        return core[0] + _joined_from(core)
+    def _sorted_ops(_):
+        slots, sorted_ts = _sorted_slots()
+        return slots + _join_ops(sorted_ts)
 
     def _res_hint(hint, want, op_slot_arr):
         """One link-hint resolution: verified int32 gather (see the
@@ -406,39 +399,20 @@ def _materialize(ops: Dict[str, jax.Array],
         # exactly one canonical per used slot (pos values are unique), so
         # these scatters are parallel-path even under hostile ranks
         tgt_op = jnp.where(is_canon_op, op_slot_r, M)
-
-        def scat_op(init, vals):
-            return init.at[tgt_op].set(vals, mode="drop",
-                                       unique_indices=True)
-
-        node_ts_r = scat_op(jnp.full(M, BIG, jnp.int64), ts) \
+        node_ts_r = jnp.full(M, BIG, jnp.int64).at[tgt_op].set(
+            ts, mode="drop", unique_indices=True) \
             .at[ROOT].set(0).at[NULL].set(BIG)
-        node_depth_r = scat_op(jnp.zeros(M, jnp.int32), depth) \
-            .at[ROOT].set(0)
-        node_value_ref_r = scat_op(jnp.full(M, -1, jnp.int32), value_ref)
-        node_pos_r = win
-        node_claimed_r = jnp.zeros((M, D), jnp.int64).at[tgt_op].set(
-            paths, mode="drop", unique_indices=True)
-        is_node_slot_r = scat_op(jnp.zeros(M, bool), jnp.ones(N, bool))
-        node_anchor_sent_r = scat_op(jnp.zeros(M, bool), anchor_ts == 0)
+        is_node_slot_r = jnp.zeros(M, bool).at[tgt_op].set(
+            jnp.ones(N, bool), mode="drop", unique_indices=True)
 
         ((pp_slot, pp_found, pp_miss),
          (aa_slot, aa_found, aa_miss),
          (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot_r)
-        ranked = (op_slot_r, op_is_dup_r, node_ts_r, node_depth_r,
-                  node_value_ref_r, node_pos_r, node_claimed_r,
-                  is_node_slot_r, node_anchor_sent_r,
-                  scat_op(jnp.full(M, NULL, jnp.int32), pp_slot),
-                  scat_op(jnp.full(M, NULL, jnp.int32), aa_slot),
-                  tt_slot, pp_slot,
-                  scat_op(jnp.zeros(M, bool), pp_found),
-                  scat_op(jnp.zeros(M, bool), aa_found),
-                  tt_found, pp_found)
+        ranked = (op_slot_r, op_is_dup_r, node_ts_r, win, is_node_slot_r,
+                  pp_slot, aa_slot, tt_slot,
+                  pp_found, aa_found, tt_found)
         if hints == "exhaustive":
-            (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
-             node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
-             pslot, aslot, d_tslot, dp_slot,
-             pfound, afound, d_tfound, dp_found) = ranked
+            sel = ranked
         else:
             # rank verification: the four properties below hold iff
             # ts_rank is exactly the unique-add-timestamp rank
@@ -455,51 +429,53 @@ def _materialize(ops: Dict[str, jax.Array],
                 jnp.any(tt_miss & is_del)
             hints_ok = dense_ok & incr_ok & ts_match & all_ranked & \
                 ~link_miss
-            (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
-             node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
-             pslot, aslot, d_tslot, dp_slot,
-             pfound, afound, d_tfound, dp_found) = lax.cond(
-                hints_ok, lambda _: ranked, _build_sorted_joined, None)
+            sel = lax.cond(hints_ok, lambda _: ranked, _sorted_ops, None)
     elif have_link:
         # link hints without ranks: sorted slot assignment runs eagerly,
         # hinted resolution with per-reference verification; the JOIN
         # stays inside the cond fallback so verified-hint merges never
         # execute it
-        core = _sorted_core()
-        (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
-         node_pos, node_claimed, is_node_slot,
-         node_anchor_is_sentinel) = core[0]
-
+        slots, sorted_ts = _sorted_slots()
         ((pp_slot, pp_found, pp_miss),
          (aa_slot, aa_found, aa_miss),
-         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot)
-        canon_tgt = jnp.where(~op_is_dup & (op_slot != NULL), op_slot, M)
-
-        def scat_c(init, vals):
-            return init.at[canon_tgt].set(vals, mode="drop",
-                                          unique_indices=True)
-
-        hinted = (scat_c(jnp.full(M, NULL, jnp.int32), pp_slot),
-                  scat_c(jnp.full(M, NULL, jnp.int32), aa_slot),
-                  tt_slot, pp_slot,
-                  scat_c(jnp.zeros(M, bool), pp_found),
-                  scat_c(jnp.zeros(M, bool), aa_found),
-                  tt_found, pp_found)
+         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(slots[0])
+        hinted = (pp_slot, aa_slot, tt_slot,
+                  pp_found, aa_found, tt_found)
         if hints == "exhaustive":
-            (pslot, aslot, d_tslot, dp_slot,
-             pfound, afound, d_tfound, dp_found) = hinted
+            resolution = hinted
         else:
             any_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
                 jnp.any(tt_miss & is_del)
-            (pslot, aslot, d_tslot, dp_slot,
-             pfound, afound, d_tfound, dp_found) = lax.cond(
-                any_miss, lambda _: _joined_from(core),
+            resolution = lax.cond(
+                any_miss, lambda _: _join_ops(sorted_ts),
                 lambda _: hinted, None)
+        sel = slots + tuple(resolution)
     else:
-        (op_slot, op_is_dup, node_ts, node_depth, node_value_ref,
-         node_pos, node_claimed, is_node_slot, node_anchor_is_sentinel,
-         pslot, aslot, d_tslot, dp_slot,
-         pfound, afound, d_tfound, dp_found) = _build_sorted_joined(None)
+        sel = _sorted_ops(None)
+
+    (op_slot, op_is_dup, node_ts, node_pos, is_node_slot,
+     pp_slot, aa_slot, tt_slot, pp_found, aa_found, tt_found) = sel
+
+    # ---- 3. Node-table construction from the SELECTED assignment —
+    # shared across all branches, outside any cond.  Exactly one
+    # canonical op per used slot, so every scatter is parallel-path.
+    canon = ~op_is_dup & (op_slot != NULL)
+    tgt_c = jnp.where(canon, op_slot, M)
+
+    def scat_c(init, vals):
+        return init.at[tgt_c].set(vals, mode="drop", unique_indices=True)
+
+    node_depth = scat_c(jnp.zeros(M, jnp.int32), depth).at[ROOT].set(0)
+    node_value_ref = scat_c(jnp.full(M, -1, jnp.int32), value_ref)
+    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt_c].set(
+        paths, mode="drop", unique_indices=True)
+    node_anchor_is_sentinel = scat_c(jnp.zeros(M, bool), anchor_ts == 0)
+    pslot = scat_c(jnp.full(M, NULL, jnp.int32), pp_slot)
+    aslot = scat_c(jnp.full(M, NULL, jnp.int32), aa_slot)
+    pfound = scat_c(jnp.zeros(M, bool), pp_found)
+    afound = scat_c(jnp.zeros(M, bool), aa_found)
+    d_tslot, d_tfound = tt_slot, tt_found
+    dp_slot, dp_found = pp_slot, pp_found
     pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
 
     # Full materialised path: claimed anchor path with the node's own ts
