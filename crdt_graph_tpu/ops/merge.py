@@ -46,13 +46,27 @@ this forest (enter/exit token per node, successor pointers from one sibling
 sort) and list-ranking it.
 
 TPU-shaped engineering (the difference between this and a naive lax
-translation — v5e has no native int64 and random HBM gathers are the
+translation — v5e has no native int64, sorts are the costliest XLA
+primitive at this scale, and random HBM gathers are the bandwidth
 bottleneck):
 
-- **One 64-bit sort, then dense int32 slots.**  Timestamps are sorted once
-  as (hi, lo) int32 key pairs; every downstream comparison uses the dense
-  slot ids, whose order IS timestamp order.  No int64 feeds a sort or a
-  pointer loop after step 1.
+- **No device sort, no device join on the common path.**  The host walks
+  every op once at ingest anyway, so it ships dense timestamp RANKS
+  (``ts_rank``) and reference POSITIONS (link hints) with the batch
+  (codec/packed.py); the kernel scatters ops straight into
+  timestamp-ordered int32 slots and resolves every anchor/parent/target
+  reference with one verified gather.  In auto mode both hint families
+  are re-verified on device — properties that hold iff the hints are
+  exactly right — and any violation routes the batch through the
+  sort+join construction via ``lax.cond`` (same 11-tuple interface, all
+  downstream stages path-agnostic), so wrong hints cost speed, never
+  correctness.  Slot ids compare like timestamps everywhere downstream;
+  no int64 feeds a sort or a pointer loop.
+- **Sorts only where contested.**  The one remaining sort — ordering
+  sibling groups — runs at a small static width over just the rows whose
+  parent has ≥ 2 children (count + prefix-sum compaction); chain-
+  dominated logs contract to a few dozen contested rows, and the M-wide
+  sort survives only as the adversarial ``lax.cond`` fallback.
 - **Exact path validation, one row gather per check.**  "Claimed prefix ==
   parent's materialised path" (what the reference's recursive descent
   checks, Internal/Node.elm:138-163) is one [M, D] gather of the parent's
@@ -60,8 +74,7 @@ bottleneck):
   the op's own claimed row (already op-indexed — no second gather); the
   delete-target check is the same shape.  Exact equality — no hash, so no
   collision surface for adversarial peers (a fixed-base polynomial hash
-  here would let a malicious op forge a colliding path).  Cost vs a 1-wide
-  hash compare is a D-wide gather (D ≤ 16), noise next to the sorts.
+  here would let a malicious op forge a colliding path).
 - **Fixpoint loops exit early.**  Validity cascading, tombstone-subtree
   propagation and the nearest-smaller-ancestor chase are pointer-doubling
   loops that need their worst-case O(log N) trips only for adversarial
@@ -70,10 +83,15 @@ bottleneck):
 - **Run-contracted list ranking.**  The Euler tour of real op logs is
   dominated by ±1-stride index runs (insertion chains produce consecutive
   slots whose tour tokens chain consecutively).  Maximal runs are detected
-  elementwise, contracted by a prefix-sum, and Wyllie pointer-doubling runs
-  on the *contracted* list — O(log #runs) trips instead of O(log 2M); ranks
-  expand back elementwise.  A 64-chain million-op merge contracts to a few
-  hundred list elements.
+  elementwise and the whole per-run pipeline — derivation, weighted
+  Wyllie doubling, expansion sources — runs at a small static width when
+  the run count fits (full width only for fragmented adversarial tours);
+  ranks expand back at enter-token width via the pallas monotone-gather
+  kernel (ops/mono_gather.py) on TPU.  A 64-chain million-op merge
+  contracts to a few hundred list elements.
+- **Static all-adds specialization.**  Batches with no deletes (the
+  common serving shape) drop the tombstone machinery from the trace via
+  a host-checked promise (``host_no_deletes``).
 
 Deletes tombstone a node and kill its whole subtree (a tombstone's children
 are discarded, Internal/Node.elm:237-238); tombstones keep their list
