@@ -129,6 +129,29 @@ def _mirror_expected(raw) -> np.ndarray:
                     dtype=np.int64)
 
 
+def _divergence_detail(ops: Dict[str, np.ndarray],
+                       expected: np.ndarray) -> str:
+    """Untimed host-side diff for a failed fused order check.
+
+    The timed check collapses to one boolean, so by itself an order-only
+    mismatch with equal counts would be indistinguishable from a count
+    mismatch (ADVICE r3).  This reruns the merge once outside the timing
+    loop and reports the first divergent visible index."""
+    with jax.enable_x64(True):
+        t = merge._materialize(jax.device_put(ops))
+        seq = np.asarray(t.ts[t.visible_order])[:int(t.num_visible)]
+    n_got, n_want = int(seq.shape[0]), int(expected.shape[0])
+    m = min(n_got, n_want)
+    diff = np.nonzero(seq[:m] != expected[:m])[0]
+    if diff.size:
+        i = int(diff[0])
+        return (f"first divergence at visible index {i} "
+                f"(got ts {int(seq[i])}, want {int(expected[i])}); "
+                f"got {n_got} visible, want {n_want}")
+    return (f"sequences agree on the first {m} entries; "
+            f"got {n_got} visible, want {n_want}")
+
+
 def run(config_ids: Optional[Iterable[int]] = None,
         repeats: int = 5, check: bool = True) -> list:
     """Time every config with the order check FUSED into the timed
@@ -150,8 +173,7 @@ def run(config_ids: Optional[Iterable[int]] = None,
         if check:
             exact = row.pop("order_exact")   # single source in the row
             row["order_check"] = "exact" if exact else (
-                f"MISMATCH (got {row['num_visible']} visible, "
-                f"want {expected.shape[0]})")
+                "MISMATCH: " + _divergence_detail(ops, expected))
         results.append(row)
         print(json.dumps(row), flush=True)
     return results

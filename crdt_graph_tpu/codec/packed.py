@@ -170,6 +170,38 @@ def compute_ts_rank(kind: np.ndarray, ts: np.ndarray) -> np.ndarray:
     return rank
 
 
+def verify_hints(p: PackedOps) -> bool:
+    """Host-side audit that the hint columns carry exactly what the
+    kernel's "exhaustive" mode assumes (ADVICE r3: a restore must not
+    trust a persisted vouch over possibly stale/corrupt columns).
+
+    True iff (a) ``ts_rank`` equals a fresh ``compute_ts_rank`` over the
+    loaded kind/ts columns and (b) every nonzero in-batch-resolvable
+    reference (parent for every real op, anchor for adds, target for
+    deletes) carries a hint that verifies (points at an add row whose
+    ``ts`` equals the referenced timestamp).  These are the properties
+    the kernel's auto mode re-derives on device (ops/merge.py rank/link
+    verification); when they hold, exhaustive and auto are semantically
+    identical, so a batch passing this check may keep the cond-free
+    path."""
+    if not np.array_equal(p.ts_rank, compute_ts_rank(p.kind, p.ts)):
+        return False
+    n = p.capacity
+    is_add = p.kind == KIND_ADD
+    uniq = np.unique(p.ts[is_add & (p.ts > 0)])
+
+    def _refs_ok(active, want, hint):
+        nonzero = active & (want > 0) & (want < MAX_TS)
+        h = np.clip(hint, 0, n - 1)
+        verified = (hint >= 0) & (hint < n) & is_add[h] & (p.ts[h] == want)
+        in_batch = np.isin(want, uniq)
+        return bool(np.all(~(nonzero & in_batch) | verified))
+
+    return (_refs_ok(p.kind != KIND_PAD, p.parent_ts, p.parent_pos)
+            and _refs_ok(is_add, p.anchor_ts, p.anchor_pos)
+            and _refs_ok(p.kind == KIND_DELETE, p.ts, p.target_pos))
+
+
 def _bucket(n: int, minimum: int = 8) -> int:
     cap = minimum
     while cap < n:

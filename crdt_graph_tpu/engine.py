@@ -774,7 +774,7 @@ class TpuTree:
                 anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
                 value_ref=p.value_ref, pos=p.pos,
                 parent_pos=p.parent_pos, anchor_pos=p.anchor_pos,
-                target_pos=p.target_pos,
+                target_pos=p.target_pos, ts_rank=p.ts_rank,
                 values=np.frombuffer(json.dumps(p.values).encode(),
                                      np.uint8),
                 meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
@@ -796,10 +796,19 @@ class TpuTree:
             parent_pos=z["parent_pos"] if "parent_pos" in z.files else None,
             anchor_pos=z["anchor_pos"] if "anchor_pos" in z.files else None,
             target_pos=z["target_pos"] if "target_pos" in z.files else None,
+            # persisted so the restore audit below covers rank staleness
+            # (absent in older files: __post_init__ recomputes from ts)
+            ts_rank=z["ts_rank"] if "ts_rank" in z.files else None,
             # provenance survives the round trip: a vouched writer's
             # complete hint columns keep restored trees on the cond-free
             # exhaustive path; absent meta (old files) stays unvouched
             hints_vouched=bool(meta.get("hints_vouched", False)))
+        # the vouch rides in the same file as the columns it vouches for,
+        # so a stale/hand-edited/corrupt checkpoint could pair a True flag
+        # with wrong hints and silently mis-resolve under the cond-free
+        # mode (ADVICE r3) — re-verify on host before honoring it
+        if p.hints_vouched and not packed_mod.verify_hints(p):
+            p.hints_vouched = False
         tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
         tree._log = packed_mod.unpack(p)
         tree._packed = p

@@ -293,7 +293,7 @@ def _materialize(ops: Dict[str, jax.Array],
     # binary search was 1.67 s device time at 1M ops on v5e).
     #
     # RANKED+HINTED (ingest hints): ``ts_rank`` assigns slots directly
-    # (slot = rank+1, canonical copy = min batch pos per slot, one
+    # (slot = rank+1, canonical copy = min array row per slot, one
     # scatter-min) and link-hint columns resolve each reference with one
     # verified int32 gather — no sort, no join: the full-width device
     # sort was the kernel's single most expensive stage on v5e.  In auto
@@ -408,25 +408,31 @@ def _materialize(ops: Dict[str, jax.Array],
         is_real_add = is_add & (ts > 0) & (ts < BIG)
         has_rank = is_real_add & (rank >= 0) & (rank < N)
         op_slot_r = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
-        # canonical copy = min batch pos per slot (pos is the row index,
-        # so this is first-arrival, matching the stable sort)
+        # canonical copy = min ARRAY ROW per slot — the same winner rule
+        # as the sorted construction's stable sort (first array row wins),
+        # independent of the pos column, so a producer violating the
+        # pos == array-index contract cannot make the two paths disagree
+        row_idx = jnp.arange(N, dtype=jnp.int32)
         win = jnp.full(M, IPOS, jnp.int32).at[
-            jnp.where(has_rank, op_slot_r, M)].min(pos, mode="drop")
-        is_canon_op = has_rank & (pos == win[op_slot_r])
+            jnp.where(has_rank, op_slot_r, M)].min(row_idx, mode="drop")
+        is_canon_op = has_rank & (row_idx == win[op_slot_r])
         op_is_dup_r = has_rank & ~is_canon_op
-        # exactly one canonical per used slot (pos values are unique), so
+        # exactly one canonical per used slot (row indices are unique), so
         # these scatters are parallel-path even under hostile ranks
         tgt_op = jnp.where(is_canon_op, op_slot_r, M)
         node_ts_r = jnp.full(M, BIG, jnp.int64).at[tgt_op].set(
             ts, mode="drop", unique_indices=True) \
             .at[ROOT].set(0).at[NULL].set(BIG)
+        node_pos_r = jnp.full(M, IPOS, jnp.int32).at[tgt_op].set(
+            pos, mode="drop", unique_indices=True)
         is_node_slot_r = jnp.zeros(M, bool).at[tgt_op].set(
             jnp.ones(N, bool), mode="drop", unique_indices=True)
 
         ((pp_slot, pp_found, pp_miss),
          (aa_slot, aa_found, aa_miss),
          (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot_r)
-        ranked = (op_slot_r, op_is_dup_r, node_ts_r, win, is_node_slot_r,
+        ranked = (op_slot_r, op_is_dup_r, node_ts_r, node_pos_r,
+                  is_node_slot_r,
                   pp_slot, aa_slot, tt_slot,
                   pp_found, aa_found, tt_found)
         if hints == "exhaustive":

@@ -458,3 +458,51 @@ def test_no_deletes_trace_parity():
     with_del = ops + [Delete(ops[0].path[:0] + (ops[0].ts,))]
     p2 = packed.pack(with_del)
     assert not merge.host_no_deletes(p2.arrays()["kind"])
+
+
+def test_hostile_pos_duplicate_winner_agrees():
+    """ADVICE r3: a raw-array producer violating the pos == array-index
+    contract must not let the ranked path and the join fallback pick
+    different canonical copies of a duplicated timestamp.  Both paths
+    share one winner rule — the first ARRAY ROW — so the surfaced
+    payload/value_ref/status cannot depend on which construction ran."""
+    ops = [Add(1, (0,), "first"), Add(1, (0,), "second"),
+           Add(2, (1,), "tail")]
+    p = packed.pack(ops)
+    arrs = dict(p.arrays())
+    hostile = np.asarray(arrs["pos"]).copy()
+    hostile[0], hostile[1] = 1, 0       # pos claims row 1 arrived first
+    arrs["pos"] = hostile
+    t_rank = view.to_host(merge.materialize(arrs))           # ranked path
+    t_join = view.to_host(merge.materialize(arrs, hints="join"))
+    assert view.visible_values(t_rank, p.values) == \
+        view.visible_values(t_join, p.values) == ["first", "tail"]
+    assert view.statuses(t_rank, p.num_ops) == \
+        view.statuses(t_join, p.num_ops)
+
+
+def test_verify_hints_audits_rank_and_links():
+    """packed.verify_hints (the restore-time host audit, ADVICE r3)
+    accepts a pack-produced batch and rejects each corruption class:
+    stale ranks, mislinked hints, and a dropped hint whose reference is
+    resolvable in-batch."""
+    ops = [Add(1, (0,), "a"), Add(2, (1,), "b"), Add(3, (2,), "c"),
+           Add(4, (1, 0), "d"), Delete((2,))]
+    p = packed.pack(ops)
+    assert packed.verify_hints(p)
+
+    import dataclasses as dc
+
+    def mutated(**cols):
+        q = dc.replace(p, **{k: np.asarray(v).copy()
+                             for k, v in cols.items()})
+        return packed.verify_hints(q)
+
+    r = p.ts_rank.copy(); r[0], r[1] = r[1], r[0]
+    assert not mutated(ts_rank=r)
+    a = p.anchor_pos.copy(); a[a >= 0] = 0
+    assert not mutated(anchor_pos=a)
+    t = p.target_pos.copy(); t[t >= 0] = -1     # drop a resolvable hint
+    assert not mutated(target_pos=t)
+    pp = p.parent_pos.copy(); pp[3] = 2         # wrong row for d's parent
+    assert not mutated(parent_pos=pp)
