@@ -137,9 +137,8 @@ def _divergence_detail(ops: Dict[str, np.ndarray],
     mismatch with equal counts would be indistinguishable from a count
     mismatch (ADVICE r3).  This reruns the merge once outside the timing
     loop and reports the first divergent visible index."""
-    with jax.enable_x64(True):
-        t = merge._materialize(jax.device_put(ops))
-        seq = np.asarray(t.ts[t.visible_order])[:int(t.num_visible)]
+    t = merge.materialize(ops)
+    seq = np.asarray(t.ts[t.visible_order])[:int(t.num_visible)]
     n_got, n_want = int(seq.shape[0]), int(expected.shape[0])
     m = min(n_got, n_want)
     diff = np.nonzero(seq[:m] != expected[:m])[0]

@@ -202,6 +202,35 @@ def verify_hints(p: PackedOps) -> bool:
             and _refs_ok(p.kind == KIND_DELETE, p.ts, p.target_pos))
 
 
+def rebuild_hints(p: PackedOps) -> None:
+    """Recompute the rank and link hint columns from kind/ts in place.
+
+    The repair path for a failed restore audit (``verify_hints``):
+    leaving corrupt hints in the object would push every later merge of
+    the tree through the kernel's sort+join fallback for its lifetime,
+    when the hints are one vectorized host pass to rebuild.  After this
+    the columns are exhaustive and consistent with the data columns by
+    construction, so the vouch is re-established."""
+    p.ts_rank = compute_ts_rank(p.kind, p.ts)
+    add_rows = np.nonzero((p.kind == KIND_ADD) & (p.ts > 0))[0]
+    uniq, first = np.unique(p.ts[add_rows], return_index=True)
+    first_pos = add_rows[first].astype(np.int32)
+
+    def _lookup(want, active):
+        out = np.full(p.capacity, -1, np.int32)
+        if uniq.size:
+            i = np.minimum(np.searchsorted(uniq, want), uniq.size - 1)
+            hit = active & (want > 0) & (want < MAX_TS) & (uniq[i] == want)
+            out[hit] = first_pos[i[hit]]
+        return out
+
+    p.parent_pos = _lookup(p.parent_ts, p.kind != KIND_PAD)
+    p.anchor_pos = _lookup(p.anchor_ts, p.kind == KIND_ADD)
+    p.target_pos = _lookup(p.ts, p.kind == KIND_DELETE)
+    p.ts_index = None
+    p.hints_vouched = True
+
+
 def _bucket(n: int, minimum: int = 8) -> int:
     cap = minimum
     while cap < n:

@@ -806,9 +806,11 @@ class TpuTree:
         # the vouch rides in the same file as the columns it vouches for,
         # so a stale/hand-edited/corrupt checkpoint could pair a True flag
         # with wrong hints and silently mis-resolve under the cond-free
-        # mode (ADVICE r3) — re-verify on host before honoring it
+        # mode (ADVICE r3) — re-verify on host before honoring it, and
+        # REBUILD rather than demote on failure: keeping corrupt hints
+        # would route every later merge through the sort+join fallback
         if p.hints_vouched and not packed_mod.verify_hints(p):
-            p.hints_vouched = False
+            packed_mod.rebuild_hints(p)
         tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
         tree._log = packed_mod.unpack(p)
         tree._packed = p

@@ -177,36 +177,42 @@ def test_distributed_explicit_cluster_failure_raises():
         distributed.initialize("256.0.0.1:1", num_processes=2, process_id=5)
 
 
-def test_restore_rejects_stale_vouch(tmp_path):
+def test_restore_repairs_stale_vouch(tmp_path):
     """ADVICE r3: hints_vouched rides in the same npz as the hint columns
     it vouches for, so restore_packed re-verifies on host.  A tampered
-    checkpoint (mislinked parent_pos under a True vouch) must drop to the
-    device-verified auto mode — and still converge to the writer's view
-    through the kernel's join fallback."""
+    checkpoint (mislinked parent_pos under a True vouch) must not reach
+    the cond-free exhaustive mode with the corrupt columns — the restore
+    audit catches it and REBUILDS the hints (keeping them corrupt would
+    cost the sort+join fallback on every later merge), so the restored
+    tree is both correct and back on the fast path."""
     _, ops = _random_session(45, n_replicas=3, steps=40)
     t = engine.init(6)
     t.apply(crdt.Batch(tuple(ops)))
     path = str(tmp_path / "snap.npz")
     t.checkpoint_packed(path)
-    z = dict(np.load(path))
-    bad = z["parent_pos"].copy()
-    bad[bad >= 0] = 0                   # mislink every resolved parent
-    z["parent_pos"] = bad
-    with open(path, "wb") as f:
-        np.savez_compressed(f, **z)
-    back = engine.TpuTree.restore_packed(path)
-    assert not back._packed.hints_vouched
-    assert back.visible_values() == t.visible_values()
 
-    # same contract for the persisted rank hints: swapped ranks under a
-    # True vouch are caught by the restore audit
+    def tamper(mutate):
+        z = dict(np.load(path))
+        mutate(z)
+        with open(path, "wb") as f:
+            np.savez_compressed(f, **z)
+        back = engine.TpuTree.restore_packed(path)
+        assert back._packed.hints_vouched
+        assert packed.verify_hints(back._packed)   # repaired, not trusted
+        assert back.visible_values() == t.visible_values()
+
+    def mislink(z):
+        z["parent_pos"][z["parent_pos"] >= 0] = 0
+
+    def rank_swap(z):
+        # two rows with DISTINCT ranks (duplicate deliveries share a
+        # rank, and swapping equal ranks would be a no-op tamper)
+        adds = np.nonzero(z["ts_rank"] >= 0)[0]
+        r = z["ts_rank"][adds]
+        j = int(np.nonzero(r != r[0])[0][0])
+        a, b = adds[0], adds[j]
+        z["ts_rank"][a], z["ts_rank"][b] = z["ts_rank"][b], z["ts_rank"][a]
+
+    tamper(mislink)
     t.checkpoint_packed(path)
-    z = dict(np.load(path))
-    adds = np.nonzero(z["ts_rank"] >= 0)[0]
-    z["ts_rank"][adds[0]], z["ts_rank"][adds[1]] = \
-        z["ts_rank"][adds[1]], z["ts_rank"][adds[0]]
-    with open(path, "wb") as f:
-        np.savez_compressed(f, **z)
-    back = engine.TpuTree.restore_packed(path)
-    assert not back._packed.hints_vouched
-    assert back.visible_values() == t.visible_values()
+    tamper(rank_swap)
