@@ -590,6 +590,47 @@ class TpuTree:
         return op_mod.from_list(
             op_mod.since(initial_timestamp, list(reversed(self._log))))
 
+    def dumps_since_bytes(self, initial_timestamp: int) -> bytes:
+        """Wire JSON bytes for ``operations_since`` without per-op
+        Python encode: the packed columns stream through the native
+        egress encoder (native/fastcodec.cpp ``encode_pack``) — the
+        fast path for the reference's full-state bootstrap contract
+        (``operationsSince 0`` replays the whole log,
+        CRDTree.elm:408-418), where recursive per-op encode costs
+        seconds at headline scale.  Byte-identical to
+        ``json_codec.dumps(self.operations_since(ts))`` (pinned by the
+        differential suite in tests/test_native_codec.py); falls back
+        to exactly that when the native module is unavailable or a
+        value payload isn't native-encodable.  Returned as bytes so the
+        service can write the multi-megabyte bootstrap payload straight
+        to the socket with no str round trip."""
+        from . import native
+        from .codec import json_codec
+        if native.available():
+            p = self._ensure_packed()
+            n = p.num_ops
+            if initial_timestamp == 0:
+                start = 0
+            else:
+                # op_mod.since semantics: suffix from the LAST Add whose
+                # timestamp matches, inclusive; no match -> empty batch
+                hits = np.nonzero(
+                    (p.kind[:n] == packed_mod.KIND_ADD) &
+                    (p.ts[:n] == initial_timestamp))[0]
+                if hits.size == 0:
+                    return b'{"op":"batch","ops":[]}'
+                start = int(hits[-1])
+            try:
+                return native.encode_pack(p, start)
+            except ValueError:
+                pass  # non-JSON-native payload: take the Python path
+        return json_codec.dumps(
+            self.operations_since(initial_timestamp)).encode()
+
+    def dumps_since(self, initial_timestamp: int) -> str:
+        """:meth:`dumps_since_bytes` as text."""
+        return self.dumps_since_bytes(initial_timestamp).decode()
+
     # -- queries ----------------------------------------------------------
 
     def _slot_at(self, path: Tuple[int, ...]) -> Optional[int]:
@@ -749,12 +790,16 @@ class TpuTree:
         tree._last_operation = json_codec.decode(state["last_operation"])
         return tree
 
-    def checkpoint_packed(self, path: str) -> None:
+    def checkpoint_packed(self, path, compress: bool = True) -> None:
         """Binary checkpoint: the packed op columns plus clocks, written
         with numpy — the fast path for big logs (no per-op JSON).  Values
         must be JSON-encodable (they ride in one JSON sidecar field).
         Written to exactly ``path`` (a file handle sidesteps numpy's
-        .npz-suffix appending)."""
+        .npz-suffix appending); ``path`` may itself be a binary
+        file-like object (the service's snapshot wire format streams
+        this into the HTTP response).  ``compress=False`` trades ~6x
+        size for ~10x less encode time — the wire-snapshot choice,
+        where the document lock is held while encoding."""
         import json
         from .codec import json_codec
         p = self._ensure_packed()
@@ -768,8 +813,9 @@ class TpuTree:
             "last_operation": json_codec.encode(self._last_operation),
             "hints_vouched": p.hints_vouched,
         }
-        with open(path, "wb") as f:
-            np.savez_compressed(
+        f = path if hasattr(path, "write") else open(path, "wb")
+        try:
+            (np.savez_compressed if compress else np.savez)(
                 f, kind=p.kind, ts=p.ts, parent_ts=p.parent_ts,
                 anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
                 value_ref=p.value_ref, pos=p.pos,
@@ -778,9 +824,22 @@ class TpuTree:
                 values=np.frombuffer(json.dumps(p.values).encode(),
                                      np.uint8),
                 meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
+        finally:
+            if f is not path:
+                f.close()
 
     @staticmethod
-    def restore_packed(path: str) -> "TpuTree":
+    def restore_packed(path, replica: Optional[int] = None) -> "TpuTree":
+        """Rebuild a tree from ``checkpoint_packed`` output; ``path`` may
+        be a filesystem path or a binary file-like (e.g. a BytesIO over
+        the service's ``GET /docs/{id}/snapshot`` response).
+
+        ``replica`` adopts a NEW identity for the restored tree — the
+        snapshot-bootstrap contract: a served snapshot carries the
+        SERVER's replica id, so an editing client must restore under its
+        own id (from ``POST /replicas``) or every snapshot-bootstrapped
+        client would mint the same timestamps and their concurrent edits
+        would collide (first-arrival dedup absorbing one silently)."""
         import json
         from .codec import json_codec
         z = np.load(path)
@@ -811,12 +870,21 @@ class TpuTree:
         # would route every later merge through the sort+join fallback
         if p.hints_vouched and not packed_mod.verify_hints(p):
             packed_mod.rebuild_hints(p)
-        tree = TpuTree(meta["replica"], max_depth=meta["max_depth"])
+        rid = meta["replica"] if replica is None else replica
+        tree = TpuTree(rid, max_depth=meta["max_depth"])
         tree._log = packed_mod.unpack(p)
         tree._packed = p
-        tree._timestamp = meta["timestamp"]
         tree._cursor = tuple(meta["cursor"])
         tree._replicas = {int(k): v for k, v in meta["replicas"].items()}
+        if rid == meta["replica"]:
+            tree._timestamp = meta["timestamp"]
+        else:
+            # adopting a new identity: the own-op clock restarts at this
+            # replica's last timestamp seen in the log (0 ops -> counter
+            # 0), NOT the writer's clock — two clients restoring the
+            # same served snapshot must not mint colliding timestamps
+            tree._timestamp = max(ts_mod.make(rid, 0),
+                                  tree._replicas.get(rid, 0))
         tree._last_operation = json_codec.decode(meta["last_operation"])
         return tree
 

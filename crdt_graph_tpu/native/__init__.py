@@ -117,6 +117,30 @@ def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
     return out
 
 
+def encode_pack(p: PackedOps, start: int = 0) -> bytes:
+    """:class:`PackedOps` columns → wire JSON bytes via the native
+    encoder — the egress mirror of :func:`parse_pack` (one C++ pass, no
+    per-op Python objects).  Emits ``{"op":"batch","ops":[...]}`` for
+    rows ``[start, num_ops)``, byte-compatible with
+    ``json_codec.dumps`` of the same ops.
+
+    Raises ``RuntimeError`` when the native module is unavailable —
+    callers wanting transparent fallback use
+    :meth:`engine.TpuTree.dumps_since`.
+    """
+    mod = load()
+    if mod is None:
+        raise RuntimeError(f"native codec unavailable: {_build_error}")
+    n = p.num_ops
+    return mod.encode_pack(
+        np.ascontiguousarray(p.kind[:n], dtype=np.int8),
+        np.ascontiguousarray(p.ts[:n], dtype=np.int64),
+        np.ascontiguousarray(p.depth[:n], dtype=np.int32),
+        np.ascontiguousarray(p.paths[:n], dtype=np.int64),
+        np.ascontiguousarray(p.value_ref[:n], dtype=np.int32),
+        list(p.values), start, n, p.paths.shape[1])
+
+
 def _padded(a: np.ndarray, cap: int, fill=0) -> np.ndarray:
     out = np.full(cap, fill, dtype=a.dtype)
     out[:a.shape[0]] = a

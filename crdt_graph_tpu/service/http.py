@@ -9,7 +9,12 @@ companion text editor — interoperate unmodified):
 - ``POST /docs/{id}/ops``   body = op  → ``{"accepted": bool, "applied": op}``
   (merge a delta; rejection = causality gap, client syncs and retries)
 - ``GET  /docs/{id}/ops?since=ts``     → op batch (pull anti-entropy,
-  CRDTree.elm:390-418)
+  CRDTree.elm:390-418; served pre-encoded by the native column encoder)
+- ``GET  /docs/{id}/snapshot``         → binary packed checkpoint (npz)
+  — one-transfer bootstrap for big docs; claim an id via
+  ``POST /replicas``, restore with
+  ``TpuTree.restore_packed(io.BytesIO(body), replica=id)`` (the raw
+  snapshot carries the SERVER's id), then catch up with ``/ops?since=``
 - ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
 - ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
 
@@ -38,9 +43,12 @@ def make_handler(store: DocumentStore):
             pass
 
         def _send(self, code: int, payload) -> None:
-            body = json.dumps(payload).encode()
+            self._send_raw(code, json.dumps(payload).encode())
+
+        def _send_raw(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -79,8 +87,12 @@ def make_handler(store: DocumentStore):
                 except ValueError:
                     self._send(400, {"error": "since must be an integer"})
                     return
-                self._send(200, json.loads(
-                    store.encode_ops(doc.operations_since(since))))
+                # pre-encoded fast path: the bootstrap contract serves
+                # the full log, so avoid a json.loads/dumps round trip
+                self._send_raw(200, doc.dumps_since_bytes(since))
+            elif sub == "/snapshot":
+                self._send_raw(200, doc.snapshot_packed(),
+                               ctype="application/octet-stream")
             elif sub == "/metrics":
                 self._send(200, doc.metrics())
             else:

@@ -66,6 +66,26 @@ class Document:
         with self.lock:
             return self.tree.operations_since(ts)
 
+    def dumps_since_bytes(self, ts: int) -> bytes:
+        """Pre-encoded wire JSON for ``GET /ops`` — the bootstrap fast
+        path (native column encoder, engine.TpuTree.dumps_since_bytes),
+        written to the socket with no str round trip."""
+        with self.lock:
+            return self.tree.dumps_since_bytes(ts)
+
+    def snapshot_packed(self) -> bytes:
+        """Binary snapshot wire format: the packed-checkpoint npz bytes
+        (engine.checkpoint_packed).  A client bootstraps a 1M-op doc
+        from this in one transfer + ``restore_packed`` instead of
+        replaying the JSON log, then catches up via ``/ops?since=``."""
+        import io
+        with self.lock:
+            buf = io.BytesIO()
+            # uncompressed: the lock is held while encoding, and zlib at
+            # 1M ops costs seconds (scripts/bench_egress.py)
+            self.tree.checkpoint_packed(buf, compress=False)
+            return buf.getvalue()
+
     def snapshot(self) -> List[Any]:
         with self.lock:
             return self.tree.visible_values()

@@ -1,0 +1,78 @@
+"""Egress benchmark: full-log bootstrap encode of a 1M-op document.
+
+Measures the reference's bootstrap contract (``operationsSince 0`` serving
+the whole log, CRDTree.elm:408-418) through three paths:
+
+- python: per-op recursive ``json_codec.dumps`` (the r3 baseline)
+- native: ``native.encode_pack`` (fastcodec.cpp egress mirror)
+- snapshot: binary packed checkpoint bytes (``checkpoint_packed``)
+
+Prints one JSON line per path; append to the round's sweep artifact.
+CPU-only (no device involved).
+"""
+import io
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from crdt_graph_tpu import native                      # noqa: E402
+from crdt_graph_tpu.codec import json_codec, packed    # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch   # noqa: E402
+
+
+def main(n: int = 1_000_000) -> None:
+    reps = 64
+    ops = []
+    for r in range(reps):
+        base = (r + 1) * 2 ** 32
+        prev = 0
+        for i in range(n // reps):
+            ts = base + i + 1
+            ops.append(Add(ts, (prev,), f"v{i % 997}"))
+            prev = ts
+    p = packed.pack(ops)
+
+    t0 = time.perf_counter()
+    wire_py = json_codec.dumps(Batch(tuple(ops)))
+    t1 = time.perf_counter()
+    py_s = t1 - t0
+
+    native.encode_pack(p)          # warm (module load)
+    t0 = time.perf_counter()
+    wire_native = native.encode_pack(p)
+    t1 = time.perf_counter()
+    native_s = t1 - t0
+    assert wire_native.decode() == wire_py, "egress differential FAILED"
+
+    rows = [
+        {"metric": "egress_bootstrap_1M", "path": "python_json",
+         "seconds": round(py_s, 3), "bytes": len(wire_py)},
+        {"metric": "egress_bootstrap_1M", "path": "native_encode_pack",
+         "seconds": round(native_s, 3), "bytes": len(wire_native),
+         "speedup_vs_python": round(py_s / native_s, 1),
+         "byte_identical": True},
+    ]
+
+    from crdt_graph_tpu import engine
+    t = engine.init(1)
+    t._log = list(ops)
+    t._packed = p
+    for compress in (True, False):
+        t0 = time.perf_counter()
+        buf = io.BytesIO()
+        t.checkpoint_packed(buf, compress=compress)
+        t1 = time.perf_counter()
+        rows.append({"metric": "egress_bootstrap_1M",
+                     "path": "snapshot_npz" + ("" if compress else "_raw"),
+                     "seconds": round(t1 - t0, 3),
+                     "bytes": buf.getbuffer().nbytes})
+    for row in rows:
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)

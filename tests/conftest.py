@@ -27,3 +27,35 @@ _hostenv.scrub_tpu_env(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- shared HTTP-service fixtures (test_service, test_elm_interop) --------
+
+import json as _json                   # noqa: E402
+import threading as _threading         # noqa: E402
+from http.client import HTTPConnection as _HTTPConnection  # noqa: E402
+
+import pytest as _pytest               # noqa: E402
+
+
+@_pytest.fixture()
+def server():
+    from crdt_graph_tpu.service import make_server
+    srv = make_server(port=0)
+    thread = _threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@_pytest.fixture()
+def req():
+    def _req(srv, method, path, body=None):
+        conn = _HTTPConnection("127.0.0.1", srv.server_port, timeout=30)
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        payload = _json.loads(resp.read().decode())
+        conn.close()
+        return resp.status, payload
+    return _req

@@ -19,33 +19,14 @@ fail if either the codec or the RGA semantics drift from
 CRDTree/Operation.elm:109-159 / Internal/Node.elm.
 """
 import json
-import threading
-from http.client import HTTPConnection
 
 import pytest
 
 import crdt_graph_tpu as crdt
 from crdt_graph_tpu.codec import json_codec
-from crdt_graph_tpu.service import make_server
 
-
-@pytest.fixture()
-def server():
-    srv = make_server(port=0)
-    thread = threading.Thread(target=srv.serve_forever, daemon=True)
-    thread.start()
-    yield srv
-    srv.shutdown()
-    srv.server_close()
-
-
-def req(srv, method, path, body=None):
-    conn = HTTPConnection("127.0.0.1", srv.server_port, timeout=30)
-    conn.request(method, path, body=body)
-    resp = conn.getresponse()
-    payload = json.loads(resp.read().decode())
-    conn.close()
-    return resp.status, payload
+# ``server`` and ``req`` fixtures come from tests/conftest.py (shared
+# with test_service.py)
 
 
 def canonical(payload) -> str:
@@ -78,7 +59,7 @@ def oracle_replay(wire: str):
     return tree.apply(json_codec.loads(wire))
 
 
-def push_and_compare(server, doc, wire, expect_accept=True):
+def push_and_compare(req, server, doc, wire, expect_accept=True):
     st, out = req(server, "POST", f"/docs/{doc}/ops", wire)
     if expect_accept:
         assert st == 200 and out["accepted"], out
@@ -90,9 +71,9 @@ def push_and_compare(server, doc, wire, expect_accept=True):
 
 # -- tests/CRDTreeTest.elm:324-358 — applies several remote operations ----
 
-def test_apply_batch_fixture(server):
+def test_apply_batch_fixture(server, req):
     wire = elm_batch(elm_add(1, [0], "a"), elm_add(2, [1], "b"))
-    values = push_and_compare(server, "batch", wire)
+    values = push_and_compare(req, server, "batch", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == ["a", "b"]
     # expectNode [1] "a", [2] "b" (the reference's per-path claims)
@@ -106,12 +87,12 @@ def test_apply_batch_fixture(server):
 
 # -- tests/CRDTreeTest.elm:203-258 — addBranch five levels deep -----------
 
-def test_add_branch_fixture(server):
+def test_add_branch_fixture(server, req):
     ops = [elm_add(1, [0], "a"), elm_add(2, [1, 0], "b"),
            elm_add(3, [1, 2, 0], "c"), elm_add(4, [1, 2, 3, 0], "d"),
            elm_add(5, [1, 2, 3, 4, 0], "e"), elm_add(6, [1, 2, 3, 4, 5], "f")]
     wire = elm_batch(*ops)
-    values = push_and_compare(server, "branch", wire)
+    values = push_and_compare(req, server, "branch", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == \
         ["a", "b", "c", "d", "e", "f"]
@@ -125,10 +106,10 @@ def test_add_branch_fixture(server):
 
 # -- tests/CRDTreeTest.elm:401-440 — apply Add inserts between nodes ------
 
-def test_insertion_between_nodes_fixture(server):
+def test_insertion_between_nodes_fixture(server, req):
     wire = elm_batch(elm_add(1, [0], "a"), elm_add(2, [1], "c"),
                      elm_add(3, [1], "b"))
-    values = push_and_compare(server, "insert", wire)
+    values = push_and_compare(req, server, "insert", wire)
     oracle = oracle_replay(wire)
     # same anchor [1]: higher timestamp rests closer to the anchor
     assert values == oracle.visible_values() == ["a", "b", "c"]
@@ -141,10 +122,10 @@ def test_insertion_between_nodes_fixture(server):
 
 # -- tests/CRDTreeTest.elm:443-477 — nested-branch leaves -----------------
 
-def test_add_leaf_fixture(server):
+def test_add_leaf_fixture(server, req):
     wire = elm_batch(elm_add(1, [0], "a"), elm_add(2, [1, 0], "b"),
                      elm_add(3, [1, 2], "c"))
-    values = push_and_compare(server, "leaf", wire)
+    values = push_and_compare(req, server, "leaf", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == ["a", "b", "c"]
     assert oracle.get_value((1, 2)) == "b"
@@ -155,9 +136,9 @@ def test_add_leaf_fixture(server):
 
 # -- tests/CRDTreeTest.elm:263-321 — delete marks tombstone ---------------
 
-def test_delete_fixture(server):
+def test_delete_fixture(server, req):
     wire = elm_batch(elm_add(1, [0], "a"), elm_del([1]))
-    values = push_and_compare(server, "dele", wire)
+    values = push_and_compare(req, server, "dele", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == []
     assert oracle.get_value((1,)) is None  # tombstoned, no visible value
@@ -167,11 +148,11 @@ def test_delete_fixture(server):
 
 # -- tests/CRDTreeTest.elm:480-496 — batch atomicity ----------------------
 
-def test_batch_atomicity_fixture(server):
+def test_batch_atomicity_fixture(server, req):
     # second op anchors at an absent node [9]: the reference rejects the
     # WHOLE batch (Expect.err); service answers 409, document unchanged
     wire = elm_batch(elm_add(1, [0], "a"), elm_add(2, [9], "b"))
-    values = push_and_compare(server, "atomic", wire, expect_accept=False)
+    values = push_and_compare(req, server, "atomic", wire, expect_accept=False)
     assert values == []
     with pytest.raises(crdt.CRDTError):
         oracle_replay(wire)
@@ -181,16 +162,16 @@ def test_batch_atomicity_fixture(server):
 
 # -- tests/CRDTreeTest.elm:358-399 / 498-560 — idempotence ----------------
 
-def test_add_idempotent_fixture(server):
+def test_add_idempotent_fixture(server, req):
     wire = elm_batch(*([elm_add(1, [0], "a")] * 4))
-    values = push_and_compare(server, "idem", wire)
+    values = push_and_compare(req, server, "idem", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == ["a"]
 
 
-def test_delete_idempotent_fixture(server):
+def test_delete_idempotent_fixture(server, req):
     wire = elm_batch(elm_add(1, [0], "a"), *([elm_del([1])] * 5))
-    values = push_and_compare(server, "idemdel", wire)
+    values = push_and_compare(req, server, "idemdel", wire)
     oracle = oracle_replay(wire)
     assert values == oracle.visible_values() == []
 
@@ -214,7 +195,7 @@ def test_json_fixture_bytes(wire, op):
 
 # -- multi-replica: two Elm clients through the coordinator ---------------
 
-def test_two_elm_clients_converge_through_service(server):
+def test_two_elm_clients_converge_through_service(server, req):
     """Two simulated Elm clients (hand-encoded wire, reference timestamp
     scheme replica*2^32+counter, CRDTree/Timestamp.elm) interleave edits
     through the service; the pulled logs replayed into the oracle match
@@ -227,15 +208,15 @@ def test_two_elm_clients_converge_through_service(server):
 
     # client A appends "x" at root
     wire_a = elm_batch(elm_add(ts(a, 1), [0], "x"))
-    push_and_compare(server, "doc", wire_a)
+    push_and_compare(req, server, "doc", wire_a)
     # client B (having pulled) anchors "y" after A's node
     wire_b = elm_batch(elm_add(ts(b, 1), [ts(a, 1)], "y"))
-    values = push_and_compare(server, "doc", wire_b)
+    values = push_and_compare(req, server, "doc", wire_b)
     assert values == ["x", "y"]
 
     # a third, concurrent edit racing on the same anchor
     wire_a2 = elm_batch(elm_add(ts(a, 2), [ts(a, 1)], "z"))
-    values = push_and_compare(server, "doc", wire_a2)
+    values = push_and_compare(req, server, "doc", wire_a2)
     oracle = crdt.init(77)
     _, log = req(server, "GET", "/docs/doc/ops?since=0")
     oracle = oracle.apply(json_codec.decode(log))

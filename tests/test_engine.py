@@ -269,3 +269,19 @@ def test_checkpoint_roundtrip_preserves_hint_provenance(tmp_path):
     r = engine.TpuTree.restore_packed(path)
     assert r._packed.hints_vouched
     assert r.visible_values() == t.visible_values()
+
+
+def test_dumps_since_matches_python_encode():
+    """The native egress fast path must emit byte-identical wire JSON to
+    json_codec.dumps(operations_since(ts)) for ts=0 (bootstrap), a
+    mid-log Add timestamp (inclusive suffix), and a timestamp matching
+    nothing (empty batch)."""
+    from crdt_graph_tpu.codec import json_codec
+    t = engine.init(3)
+    for i in range(20):
+        t.add(f"v{i}")
+    t.delete((t.last_replica_timestamp(3),))
+    mid = 3 * 2**32 + 7
+    for ts in (0, mid, 999):
+        want = json_codec.dumps(t.operations_since(ts))
+        assert t.dumps_since(ts) == want, ts

@@ -185,3 +185,96 @@ def test_deep_nesting_rejected_not_segfault():
     ok = ('{"op":"add","path":[0],"ts":1,"val":'
           + "[" * 100 + "1" + "]" * 100 + "}")
     assert native.parse_pack(ok).num_ops == 1
+
+
+# ===== egress: encode_pack (the parse_pack mirror) =======================
+
+def _pyside_dumps(ops):
+    return json_codec.dumps(op_mod.from_list(tuple(ops)))
+
+
+def encode_both(ops, max_depth=16):
+    p = packed.pack(ops, max_depth=max_depth)
+    return native.encode_pack(p).decode(), _pyside_dumps(ops)
+
+
+def test_encode_golden_fixtures_byte_exact():
+    ops = [crdt.Add(2, (0, 1), "a"), crdt.Delete((1, 2, 3)),
+           crdt.Add(1, (0,), "x")]
+    got, want = encode_both(ops)
+    assert got == want
+
+
+def test_encode_value_payload_types_byte_exact():
+    vals = ["str", "", "unié中😀", 0, -5, 2**40, 2**80, -2**90, 1.5,
+            -0.25, -0.0, 1e10, 1e-12, float("inf"), float("-inf"),
+            float("nan"), True, False, None, [1, [2, "x"], (3, 4)],
+            {"k": {"n": None, "l": [1.0]}, "é": "☃"},
+            {1: "a", 2.5: "b", True: "c", None: "d"},
+            "esc\"\\\n\t/control\x01\x1f"]
+    ops = [crdt.Add(i + 1, (i,), v) for i, v in enumerate(vals)]
+    got, want = encode_both(ops)
+    assert got == want
+    # NaN breaks == on reparse; compare through repr of parsed trees
+    assert repr(json.loads(got)) == repr(json.loads(want))
+
+
+def test_encode_lone_surrogates_round_trip():
+    # the parser admits lone surrogates (like json.loads); the encoder
+    # must re-emit their \uD8xx escapes exactly like json.dumps
+    payload = '{"op":"add","path":[0],"ts":1,"val":"hi\\ud800there"}'
+    p = native.parse_pack(payload)
+    assert native.encode_pack(p).decode() == \
+        _pyside_dumps([json_codec.loads(payload)])
+
+
+def test_encode_random_sessions_byte_exact():
+    rng = random.Random(7)
+    for seed in range(3):
+        ops = []
+        t = 1
+        anchors = [0]
+        for _ in range(300):
+            if ops and rng.random() < 0.2:
+                ops.append(crdt.Delete((rng.choice(anchors[1:] or [1]),)))
+            else:
+                a = rng.choice(anchors)
+                ops.append(crdt.Add(t, (a,), rng.choice(
+                    ["v%d" % t, t * 1.5, None, {"n": t}, ["l", t]])))
+                anchors.append(t)
+                t += 1
+        got, want = encode_both(ops)
+        assert got == want
+
+
+def test_encode_start_slices_suffix():
+    ops = [crdt.Add(i + 1, (i,), "v%d" % i) for i in range(10)]
+    p = packed.pack(ops)
+    got = native.encode_pack(p, start=6).decode()
+    assert got == _pyside_dumps(ops[6:])
+
+
+def test_encode_skips_padding_rows():
+    ops = [crdt.Add(1, (0,), "a"), crdt.Add(2, (1,), "b")]
+    p = packed.pack(ops, capacity=16)      # padded to 16 rows
+    # num_ops bounds the scan, but even a raw full-capacity call must
+    # skip KIND_PAD rows
+    got = native.encode_pack(p).decode()
+    assert got == _pyside_dumps(ops)
+
+
+def test_encode_rejects_unencodable_value():
+    class Opaque:
+        pass
+    p = packed.pack([crdt.Add(1, (0,), Opaque())])
+    with pytest.raises(ValueError):
+        native.encode_pack(p)
+
+
+def test_parse_encode_round_trip_is_identity():
+    payload = ('{"op":"batch","ops":['
+               '{"op":"add","path":[0],"ts":1,"val":{"rich":[1,2.5,null]}},'
+               '{"op":"add","path":[1],"ts":2,"val":"x"},'
+               '{"op":"del","path":[1]}]}')
+    p = native.parse_pack(payload)
+    assert native.encode_pack(p).decode() == payload

@@ -1,37 +1,18 @@
 """Wire-service end-to-end: two clients collaborating on one document over
 real HTTP, speaking the reference-compatible JSON codec."""
 import json
-import threading
-from http.client import HTTPConnection
 
 import pytest
 
 import crdt_graph_tpu as crdt
 from crdt_graph_tpu.codec import json_codec
 from crdt_graph_tpu.models import TextBuffer
-from crdt_graph_tpu.service import make_server
+
+# ``server`` and ``req`` fixtures come from tests/conftest.py (shared
+# with test_elm_interop.py)
 
 
-@pytest.fixture()
-def server():
-    srv = make_server(port=0)
-    thread = threading.Thread(target=srv.serve_forever, daemon=True)
-    thread.start()
-    yield srv
-    srv.shutdown()
-    srv.server_close()
-
-
-def req(srv, method, path, body=None):
-    conn = HTTPConnection("127.0.0.1", srv.server_port, timeout=30)
-    conn.request(method, path, body=body)
-    resp = conn.getresponse()
-    payload = json.loads(resp.read().decode())
-    conn.close()
-    return resp.status, payload
-
-
-def test_collaboration_roundtrip(server):
+def test_collaboration_roundtrip(server, req):
     # two clients join and get distinct replica ids
     _, r1 = req(server, "POST", "/docs/novel/replicas")
     _, r2 = req(server, "POST", "/docs/novel/replicas")
@@ -62,7 +43,7 @@ def test_collaboration_roundtrip(server):
     assert a.text() == "hello world"
 
 
-def test_three_client_randomized_convergence(server):
+def test_three_client_randomized_convergence(server, req):
     """Race coverage at the service level: three clients interleave local
     edits, pushes, and pulls in random order over real HTTP; everyone
     (and the server snapshot) must converge to one document."""
@@ -107,7 +88,7 @@ def test_three_client_randomized_convergence(server):
     assert server_text            # non-trivial document
 
 
-def test_duplicate_push_absorbed(server):
+def test_duplicate_push_absorbed(server, req):
     a = TextBuffer(1)
     a.insert(0, "x")
     delta = json_codec.dumps(a.operations_since(0))
@@ -119,7 +100,7 @@ def test_duplicate_push_absorbed(server):
     assert metrics["num_visible"] == 1
 
 
-def test_causality_gap_rejected_and_recoverable(server):
+def test_causality_gap_rejected_and_recoverable(server, req):
     # op anchored at a node the server has never seen → 409, doc untouched
     orphan = json_codec.dumps(crdt.Add(5 * 2**32 + 1, (999,), "z"))
     st, out = req(server, "POST", "/docs/g/ops", orphan)
@@ -135,22 +116,90 @@ def test_causality_gap_rejected_and_recoverable(server):
     assert st == 200
 
 
-def test_malformed_payload_400(server):
+def test_malformed_payload_400(server, req):
     st, _ = req(server, "POST", "/docs/m/ops", '{"op": "add"}')
     assert st == 400
     st, _ = req(server, "POST", "/docs/m/ops", "not json at all")
     assert st == 400
 
 
-def test_unknown_doc_404(server):
+def test_unknown_doc_404(server, req):
     st, _ = req(server, "GET", "/docs/nope")
     assert st == 404
     st, _ = req(server, "GET", "/bogus")
     assert st == 404
 
 
-def test_global_metrics_lists_docs(server):
+def test_global_metrics_lists_docs(server, req):
     req(server, "POST", "/docs/one/replicas")
     req(server, "POST", "/docs/two/replicas")
     _, m = req(server, "GET", "/metrics")
     assert set(m) == {"one", "two"}
+
+
+def test_ops_endpoint_serves_native_encoded_batch(server, req):
+    a = TextBuffer(1)
+    a.insert(0, "fast")
+    st, out = req(server, "POST", "/docs/fast/ops",
+                  json_codec.dumps(a.operations_since(0)))
+    assert st == 200 and out["accepted"]
+    _, ops = req(server, "GET", "/docs/fast/ops?since=0")
+    b = TextBuffer(2)
+    b.apply(json_codec.decode(ops))
+    assert b.text() == "fast"
+
+
+def test_snapshot_bootstrap_roundtrip(server, req):
+    """GET /snapshot returns the binary packed checkpoint; a client
+    restores it under its OWN replica id (from POST /replicas) in one
+    transfer and keeps replicating — the bootstrap alternative to
+    replaying the JSON log.  Without the id adoption every snapshot-
+    bootstrapped client would inherit the server's replica 0 and mint
+    colliding timestamps."""
+    import io
+    from http.client import HTTPConnection
+    from crdt_graph_tpu import engine
+
+    a = TextBuffer(1)
+    a.insert(0, "snapshot me")
+    req(server, "POST", "/docs/snap/ops",
+        json_codec.dumps(a.operations_since(0)))
+
+    def fetch_snapshot():
+        conn = HTTPConnection("127.0.0.1", server.server_port, timeout=30)
+        conn.request("GET", "/docs/snap/snapshot")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/octet-stream"
+        blob = resp.read()
+        conn.close()
+        return blob
+
+    blob = fetch_snapshot()
+    # two clients bootstrap from the SAME snapshot bytes under distinct
+    # assigned ids; their concurrent edits must not collide
+    _, r1 = req(server, "POST", "/docs/snap/replicas")
+    _, r2 = req(server, "POST", "/docs/snap/replicas")
+    b = engine.TpuTree.restore_packed(io.BytesIO(blob),
+                                      replica=r1["replica"])
+    c = engine.TpuTree.restore_packed(io.BytesIO(blob),
+                                      replica=r2["replica"])
+    assert "".join(b.visible_values()) == "snapshot me"
+    assert b.replica_id == r1["replica"] != c.replica_id
+
+    b.add("B")
+    c.add("C")
+    assert b.last_replica_timestamp(b.replica_id) != \
+        c.last_replica_timestamp(c.replica_id)
+    for t in (b, c):
+        st, out = req(server, "POST", "/docs/snap/ops",
+                      json_codec.dumps(t.last_operation))
+        assert st == 200 and out["accepted"]
+    _, snap = req(server, "GET", "/docs/snap")
+    assert sorted(v for v in snap["values"] if v in "BC") == ["B", "C"]
+
+    # the original replica converges by pulling
+    _, ops = req(server, "GET", "/docs/snap/ops?since=0")
+    b.apply(json_codec.decode(ops))
+    assert [v for v in b.visible_values() if v in "BC"] == \
+        [v for v in snap["values"] if v in "BC"]
