@@ -612,14 +612,15 @@ class TpuTree:
             if initial_timestamp == 0:
                 start = 0
             else:
-                # op_mod.since semantics: suffix from the LAST Add whose
-                # timestamp matches, inclusive; no match -> empty batch
-                hits = np.nonzero(
-                    (p.kind[:n] == packed_mod.KIND_ADD) &
-                    (p.ts[:n] == initial_timestamp))[0]
-                if hits.size == 0:
+                # op_mod.since semantics: suffix from the Add whose
+                # timestamp matches, inclusive; no match -> empty batch.
+                # The applied log holds each add timestamp at most once
+                # (duplicates absorb before reaching _log), so the cached
+                # first-occurrence index IS the since() terminator and a
+                # delta pull costs O(1) after the first build
+                start = p.index().get(initial_timestamp)
+                if start is None or start >= n:
                     return b'{"op":"batch","ops":[]}'
-                start = int(hits[-1])
             try:
                 return native.encode_pack(p, start)
             except ValueError:

@@ -43,6 +43,10 @@ def _build() -> None:
     include = sysconfig.get_path("include")
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
            f"-I{include}", _SRC, "-o", _SO]
+    if os.environ.get("GRAFT_NATIVE_ASAN"):
+        # memory-safety fuzz build (scripts/fuzz_native.py re-execs with
+        # libasan LD_PRELOADed so the sanitized .so loads into CPython)
+        cmd[1:1] = ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
@@ -132,13 +136,17 @@ def encode_pack(p: PackedOps, start: int = 0) -> bytes:
     if mod is None:
         raise RuntimeError(f"native codec unavailable: {_build_error}")
     n = p.num_ops
+    # slice to the requested suffix so a small delta pull costs O(delta),
+    # not O(document) (suffix slices of contiguous columns stay views —
+    # no copies); values is passed whole (value_ref indexes it) and
+    # borrowed, never copied
     return mod.encode_pack(
-        np.ascontiguousarray(p.kind[:n], dtype=np.int8),
-        np.ascontiguousarray(p.ts[:n], dtype=np.int64),
-        np.ascontiguousarray(p.depth[:n], dtype=np.int32),
-        np.ascontiguousarray(p.paths[:n], dtype=np.int64),
-        np.ascontiguousarray(p.value_ref[:n], dtype=np.int32),
-        list(p.values), start, n, p.paths.shape[1])
+        np.ascontiguousarray(p.kind[start:n], dtype=np.int8),
+        np.ascontiguousarray(p.ts[start:n], dtype=np.int64),
+        np.ascontiguousarray(p.depth[start:n], dtype=np.int32),
+        np.ascontiguousarray(p.paths[start:n], dtype=np.int64),
+        np.ascontiguousarray(p.value_ref[start:n], dtype=np.int32),
+        p.values, 0, n - start, p.paths.shape[1])
 
 
 def _padded(a: np.ndarray, cap: int, fill=0) -> np.ndarray:

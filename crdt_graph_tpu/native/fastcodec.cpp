@@ -224,9 +224,36 @@ struct Parser {
         }
       } else if (c < 0x20) {
         return fail("control char in string");
-      } else {
+      } else if (c < 0x80) {
         *out += char(c);
         ++p;
+      } else {
+        // Literal multi-byte sequence: validate STRICT UTF-8, exactly
+        // like json.loads on bytes input (which utf-8-decodes the whole
+        // document before parsing — invalid sequences, overlongs,
+        // surrogate encodings and > U+10FFFF are all rejections there).
+        // Escape-produced lone surrogates take the \u path above and
+        // stay admitted (WTF-8), matching Python.
+        unsigned cp;
+        int extra;
+        if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; extra = 1; }
+        else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; extra = 2; }
+        else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; extra = 3; }
+        else return fail("invalid utf-8");
+        if (end - p <= extra) return fail("invalid utf-8");
+        for (int i = 1; i <= extra; ++i) {
+          if ((static_cast<unsigned char>(p[i]) & 0xC0) != 0x80) {
+            return fail("invalid utf-8");
+          }
+          cp = (cp << 6) | (static_cast<unsigned char>(p[i]) & 0x3F);
+        }
+        static const unsigned kMin[4] = {0, 0x80, 0x800, 0x10000};
+        if (cp < kMin[extra] || cp > 0x10FFFF ||
+            (cp >= 0xD800 && cp <= 0xDFFF)) {
+          return fail("invalid utf-8");
+        }
+        out->append(reinterpret_cast<const char*>(p), size_t(extra) + 1);
+        p += extra + 1;
       }
     }
     return fail("unterminated string");
@@ -468,6 +495,7 @@ struct Parser {
     // closes and the final tag is known.  Unknown tags therefore tolerate
     // arbitrary field contents, exactly like the Python decoder.
     bool has_op = false, has_val = false;
+    bool tag_is_string = false;
     std::string tag;
     PyObject* val = nullptr;
     const char* ts_span = nullptr, *ts_span_end = nullptr;
@@ -484,7 +512,18 @@ struct Parser {
       if (p >= end || *p != ':') { ok = fail("expected ':'"); break; }
       ++p;
       if (key == "op") {
-        if (!(ok = string_raw(&tag))) break;
+        // a non-string tag is not an error: Python's decoder compares
+        // obj["op"] against the known tags and falls through to the
+        // forward-compatible empty batch, so any JSON value is admitted
+        // (last occurrence wins, like every duplicate key)
+        ws();
+        if (p < end && *p == '"') {
+          if (!(ok = string_raw(&tag))) break;
+          tag_is_string = true;
+        } else {
+          if (!(ok = skip_value())) break;
+          tag_is_string = false;
+        }
         has_op = true;
       } else if (key == "ts") {
         ws();
@@ -518,6 +557,8 @@ struct Parser {
     if (ok) {
       if (!has_op) {
         ok = fail("missing 'op' tag");
+      } else if (!tag_is_string) {
+        // unknown (non-string) tag: forward-compatible no-op
       } else if (tag == "add") {
         int64_t ts = 0;
         std::vector<int64_t> path;
@@ -720,7 +761,11 @@ struct Writer {
       Py_ssize_t run = 0;
       for (Py_ssize_t i = 0; i < len; ++i) {
         unsigned char c = (unsigned char)q[i];
-        if (c >= 0x20 && c != '"' && c != '\\') { ++run; continue; }
+        // ensure_ascii escapes DEL (0x7f) too, not just controls
+        if (c >= 0x20 && c < 0x7f && c != '"' && c != '\\') {
+          ++run;
+          continue;
+        }
         if (run) out.append(q + i - run, size_t(run));
         run = 0;
         switch (c) {
@@ -756,7 +801,7 @@ struct Writer {
           case '\r': raw("\\r"); break;
           case '\t': raw("\\t"); break;
           default:
-            if (c < 0x20) esc_unit(c);
+            if (c < 0x20 || c == 0x7f) esc_unit(c);
             else ch(char(c));
         }
         ++q;

@@ -47,6 +47,11 @@ def test_nested_batches_flatten_in_order():
 def test_unknown_tag_is_noop():
     got = assert_same('{"op":"mystery","path":[1]}')
     assert got.num_ops == 0
+    # a NON-STRING tag is also just unknown (fuzz find, r4): Python
+    # compares obj["op"] to the known tags and falls through
+    for doc in ('{"op":[42949672967297]}', '{"op":null}', '{"op":7}',
+                '{"op":{"x":1},"path":[0]}'):
+        assert assert_same(doc).num_ops == 0
     assert_same('{"op":"batch","ops":[{"op":"future","x":[{"y":1}]},'
                 '{"op":"add","path":[0],"ts":5,"val":null}]}')
 
