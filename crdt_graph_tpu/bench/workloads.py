@@ -284,6 +284,34 @@ def comb_pairs(n_ops: int = 1_000_000,
     })
 
 
+def chain_with_deletes(n_adds: int, del_every: int,
+                       n_replicas: int = 64) -> Dict[str, np.ndarray]:
+    """Mixed vectorized batch: the chain interleave plus a delete of
+    every ``del_every``-th node (full wire rows incl. hints) — the
+    standard adds+deletes shape for partitioned-merge parity suites."""
+    arrs = chain_workload(n_replicas, n_adds)
+    n = arrs["kind"].shape[0]
+    tgt = np.arange(0, n, del_every, dtype=np.int32)
+    m = tgt.size
+    cat = np.concatenate
+    out = {
+        "kind": cat([arrs["kind"], np.ones(m, np.int8)]),
+        "ts": cat([arrs["ts"], arrs["ts"][tgt]]),
+        "parent_ts": cat([arrs["parent_ts"], np.zeros(m, np.int64)]),
+        "anchor_ts": cat([arrs["anchor_ts"], arrs["ts"][tgt]]),
+        "depth": cat([arrs["depth"], np.ones(m, np.int32)]),
+        "paths": cat([arrs["paths"], arrs["ts"][tgt][:, None]]),
+        "value_ref": cat([arrs["value_ref"], np.full(m, -1, np.int32)]),
+        "pos": np.arange(n + m, dtype=np.int32),
+        "parent_pos": cat([arrs["parent_pos"],
+                           np.full(m, -1, np.int32)]),
+        "anchor_pos": cat([arrs["anchor_pos"],
+                           np.full(m, -1, np.int32)]),
+        "target_pos": cat([arrs["target_pos"], tgt]),
+    }
+    return _with_rank(out)
+
+
 def deep_paths(n_replicas: int = 64, n_ops: int = 1_000_000,
                max_depth: int = 16) -> Dict[str, np.ndarray]:
     """Maximum-depth stress: replica 1 nests a branch skeleton to

@@ -228,6 +228,102 @@ def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
     return val
 
 
+def _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL):
+    """Sort-based slot assignment (see the SORTED+JOIN contract in
+    ``_materialize``): the first five tuple entries plus the sorted
+    timestamp axis the join needs.  Module-level so the explicitly
+    partitioned resolve (parallel/shard.py) shares the one
+    implementation with the whole-array kernel."""
+    sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
+    ts_hi, ts_lo = _split_ts(sort_ts)
+    # stable sort: equal timestamps keep batch order; pos re-derives
+    # by one gather — cheaper than a fourth array through the network
+    s_hi, s_lo, sorted_idx = lax.sort(
+        (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+    sorted_pos = pos[sorted_idx]
+    sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
+        (s_lo.astype(jnp.int64) + 2**31)
+    run_start = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+    not_big = s_hi < (BIG >> 32)
+    is_canon = run_start & not_big
+    # slot of the run's canonical add = run-start index + 1
+    canon_pos = lax.cummax(jnp.where(run_start,
+                                     jnp.arange(N, dtype=jnp.int32), 0))
+    slot_of_sorted = canon_pos + 1
+    # per-op slot + duplicate flag (original batch order).  sorted_idx
+    # is a permutation — unique indices keep XLA's TPU scatter on the
+    # parallel path instead of the serialized duplicate-safe one.
+    op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
+        jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
+    op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
+        ~run_start & not_big, unique_indices=True)
+    tgt = jnp.where(is_canon, slot_of_sorted, M)
+    node_ts = jnp.full(M, BIG, jnp.int64).at[tgt].set(
+        sorted_ts, mode="drop", unique_indices=True) \
+        .at[ROOT].set(0).at[NULL].set(BIG)
+    node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
+        sorted_pos, mode="drop", unique_indices=True)
+    is_node_slot = jnp.zeros(M, bool).at[tgt].set(
+        is_canon, mode="drop", unique_indices=True)
+    return (op_slot, op_is_dup, node_ts, node_pos,
+            is_node_slot), sorted_ts
+
+
+def _join_ops_impl(sorted_ts, parent_ts, anchor_ts, ts, N, ROOT, NULL):
+    """Per-op sort-merge join (3N queries: parent, anchor, own-ts
+    against the sorted add axis; method="sort": the per-query binary
+    search was 1.67 s device time at 1M ops on v5e).  Module-level so
+    hint-verified merges can defer it into a cond branch that never
+    executes, and so parallel/shard.py's fallback shares it."""
+    queries = jnp.concatenate([parent_ts, anchor_ts, ts])
+    qidx = jnp.searchsorted(sorted_ts, queries, side="left",
+                            method="sort").astype(jnp.int32)
+    qidx_c = jnp.minimum(qidx, N - 1)
+    qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & \
+        (queries < BIG)
+    qslot = jnp.where(queries == 0, ROOT,
+                      jnp.where(qhit, qidx_c + 1, NULL)) \
+        .astype(jnp.int32)
+    qfound = (queries == 0) | qhit
+    return (qslot[:N], qslot[N:2 * N], qslot[2 * N:],
+            qfound[:N], qfound[N:2 * N], qfound[2 * N:])
+
+
+def _resolve_sorted(ops: Dict[str, jax.Array]):
+    """The full SORTED+JOIN resolution: the 11-tuple interface from raw
+    op columns, hint-free.  The whole-array kernel's fallback branch and
+    parallel/shard.py's post-gather fallback both call this."""
+    kind = ops["kind"]
+    ts = ops["ts"].astype(jnp.int64)
+    parent_ts = ops["parent_ts"].astype(jnp.int64)
+    anchor_ts = ops["anchor_ts"].astype(jnp.int64)
+    pos = ops["pos"].astype(jnp.int32)
+    N = kind.shape[0]
+    M = N + 2
+    slots, sorted_ts = _sorted_slots_impl(
+        kind == KIND_ADD, ts, pos, N, M, 0, M - 1)
+    return slots + _join_ops_impl(
+        sorted_ts, parent_ts, anchor_ts, ts, N, 0, M - 1)
+
+
+def _res_hint_impl(hint, want, op_slot_arr, is_add, ts, N, ROOT, NULL):
+    """One link-hint resolution: verified int32 gather (see the
+    RANKED+HINTED contract in ``_materialize``).  ``miss`` flags any
+    nonzero reference without a verified hint.  ``is_add``/``ts``/
+    ``op_slot_arr`` are the summary columns the hint indexes into — the
+    local batch in the whole-array kernel, the all-gathered global
+    batch in parallel/shard.py."""
+    p = jnp.clip(hint, 0, N - 1)
+    ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
+        (want > 0) & (want < BIG)
+    slot = jnp.where(want == 0, ROOT,
+                     jnp.where(ok, op_slot_arr[p], NULL))
+    miss = (want > 0) & (want < BIG) & ~ok
+    return slot.astype(jnp.int32), (want == 0) | ok, miss
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _materialize(ops: Dict[str, jax.Array],
                  use_pallas: Optional[bool] = None,
@@ -317,78 +413,19 @@ def _materialize(ops: Dict[str, jax.Array],
     # The delete-parent resolution is the per-op parent resolution
     # (dp ≡ pp), so it needs no slots of its own.
     def _sorted_slots():
-        """Sort-based slot assignment: the first five tuple entries plus
-        the sorted timestamp axis the join needs."""
-        sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
-        ts_hi, ts_lo = _split_ts(sort_ts)
-        # stable sort: equal timestamps keep batch order; pos re-derives
-        # by one gather — cheaper than a fourth array through the network
-        s_hi, s_lo, sorted_idx = lax.sort(
-            (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
-        sorted_pos = pos[sorted_idx]
-        sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
-            (s_lo.astype(jnp.int64) + 2**31)
-        run_start = jnp.concatenate(
-            [jnp.ones(1, bool),
-             (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
-        not_big = s_hi < (BIG >> 32)
-        is_canon = run_start & not_big
-        # slot of the run's canonical add = run-start index + 1
-        canon_pos = lax.cummax(jnp.where(run_start,
-                                         jnp.arange(N, dtype=jnp.int32), 0))
-        slot_of_sorted = canon_pos + 1
-        # per-op slot + duplicate flag (original batch order).  sorted_idx
-        # is a permutation — unique indices keep XLA's TPU scatter on the
-        # parallel path instead of the serialized duplicate-safe one.
-        op_slot = jnp.full(N, NULL, jnp.int32).at[sorted_idx].set(
-            jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
-        op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
-            ~run_start & not_big, unique_indices=True)
-        tgt = jnp.where(is_canon, slot_of_sorted, M)
-        node_ts = jnp.full(M, BIG, jnp.int64).at[tgt].set(
-            sorted_ts, mode="drop", unique_indices=True) \
-            .at[ROOT].set(0).at[NULL].set(BIG)
-        node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
-            sorted_pos, mode="drop", unique_indices=True)
-        is_node_slot = jnp.zeros(M, bool).at[tgt].set(
-            is_canon, mode="drop", unique_indices=True)
-        return (op_slot, op_is_dup, node_ts, node_pos,
-                is_node_slot), sorted_ts
+        return _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL)
 
     def _join_ops(sorted_ts):
-        """Per-op sort-merge join (3N queries: parent, anchor, own-ts
-        against the sorted add axis; method="sort": the per-query binary
-        search was 1.67 s device time at 1M ops on v5e).  Kept in its
-        own function so hint-verified merges can defer it into a cond
-        branch that never executes."""
-        queries = jnp.concatenate([parent_ts, anchor_ts, ts])
-        qidx = jnp.searchsorted(sorted_ts, queries, side="left",
-                                method="sort").astype(jnp.int32)
-        qidx_c = jnp.minimum(qidx, N - 1)
-        qhit = (sorted_ts[qidx_c] == queries) & (queries > 0) & \
-            (queries < BIG)
-        qslot = jnp.where(queries == 0, ROOT,
-                          jnp.where(qhit, qidx_c + 1, NULL)) \
-            .astype(jnp.int32)
-        qfound = (queries == 0) | qhit
-        return (qslot[:N], qslot[N:2 * N], qslot[2 * N:],
-                qfound[:N], qfound[N:2 * N], qfound[2 * N:])
+        return _join_ops_impl(sorted_ts, parent_ts, anchor_ts, ts,
+                              N, ROOT, NULL)
 
     def _sorted_ops(_):
         slots, sorted_ts = _sorted_slots()
         return slots + _join_ops(sorted_ts)
 
     def _res_hint(hint, want, op_slot_arr):
-        """One link-hint resolution: verified int32 gather (see the
-        RANKED+HINTED contract above).  ``miss`` flags any nonzero
-        reference without a verified hint."""
-        p = jnp.clip(hint, 0, N - 1)
-        ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
-            (want > 0) & (want < BIG)
-        slot = jnp.where(want == 0, ROOT,
-                         jnp.where(ok, op_slot_arr[p], NULL))
-        miss = (want > 0) & (want < BIG) & ~ok
-        return slot.astype(jnp.int32), (want == 0) | ok, miss
+        return _res_hint_impl(hint, want, op_slot_arr, is_add, ts,
+                              N, ROOT, NULL)
 
     def _resolve_hinted(op_slot_arr):
         pp = _res_hint(ops["parent_pos"].astype(jnp.int32), parent_ts,
@@ -477,8 +514,36 @@ def _materialize(ops: Dict[str, jax.Array],
     else:
         sel = _sorted_ops(None)
 
+    return _finish(ops, sel, use_pallas, no_deletes)
+
+
+def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
+            no_deletes: bool) -> NodeTable:
+    """Stages 3-13: node-table construction through per-op statuses,
+    from the resolution interface (the 11-tuple ``sel``).  Extracted
+    from ``_materialize`` so the explicitly partitioned resolve
+    (parallel/shard.py) reuses the exact same downstream trace — bit
+    identity across the whole-array and shard_map paths is structural,
+    not merely tested-in."""
+    kind = ops["kind"]
+    ts = ops["ts"].astype(jnp.int64)
+    anchor_ts = ops["anchor_ts"].astype(jnp.int64)
+    depth = ops["depth"].astype(jnp.int32)
+    paths = ops["paths"].astype(jnp.int64)
+    value_ref = ops["value_ref"].astype(jnp.int32)
+    pos = ops["pos"].astype(jnp.int32)
+    N = kind.shape[0]
+    D = paths.shape[1]
+    M = N + 2
+    ROOT = 0
+    NULL = M - 1
+    slot_ids = jnp.arange(M, dtype=jnp.int32)
+    cols = jnp.arange(D, dtype=jnp.int32)[None, :]
+    is_add = kind == KIND_ADD
+    is_del = kind == KIND_DELETE
     (op_slot, op_is_dup, node_ts, node_pos, is_node_slot,
      pp_slot, aa_slot, tt_slot, pp_found, aa_found, tt_found) = sel
+
 
     # ---- 3. Node-table construction from the SELECTED assignment —
     # shared across all branches, outside any cond.  Exactly one
@@ -935,7 +1000,6 @@ def _materialize(ops: Dict[str, jax.Array],
         num_nodes=jnp.sum(exists).astype(jnp.int32),
         num_visible=jnp.sum(visible).astype(jnp.int32),
         status=status)
-
 
 def host_no_deletes(kind) -> bool:
     """Host-side check backing the kernel's static no-deletes promise —

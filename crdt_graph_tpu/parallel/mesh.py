@@ -27,6 +27,13 @@ Entry points:
 - :func:`sharded_materialize` — one merge, op axis sharded over ``ops``.
 - :func:`batched_materialize` — B independent merges, vmapped on a leading
   doc axis, sharded over ``docs`` (and optionally ``ops``).
+
+This module is the auto-partitioned path (whole-array kernel + input
+shardings; XLA chooses the collectives).  The EXPLICIT schedule — per-
+shard local resolution with hand-placed pmin/all_gather boundary
+exchange, which moves ~2x fewer bytes in ~3x fewer collectives (measured:
+SWEEP_CPU_r04.jsonl) — lives in :mod:`crdt_graph_tpu.parallel.shard`;
+both are pinned bit-identical to the single-device kernel.
 """
 from __future__ import annotations
 
