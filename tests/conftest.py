@@ -28,6 +28,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Arm the engine's vouch tripwire for the whole suite: every batch that
+# reaches the kernel's cond-free exhaustive mode is re-audited on host
+# first (engine._mode), so a producer bug breaking the hint-completeness
+# invariant fails a test loudly instead of silently mis-resolving.
+os.environ.setdefault("GRAFT_DEBUG_VOUCH", "1")
+
 
 # -- shared HTTP-service fixtures (test_service, test_elm_interop) --------
 
