@@ -136,6 +136,21 @@ BIG = MAX_TS          # sorts-after-everything timestamp sentinel (python int:
                       # promotes against int64 arrays without x64-mode issues)
 IPOS = 2**31 - 1      # "no position" / +inf for int32 positions
 
+
+def _env_cap(name: str, default: int) -> int:
+    """Static compact-path width, env-overridable (GRAFT_S_CAP /
+    GRAFT_R_CAP) so the on-chip tuning session can sweep the caps
+    without code edits.  Read at TRACE time: a sweep changing the env
+    under identical shapes/static-args must ``jax.clear_caches()`` (or
+    use a fresh process) between settings, or the cached trace wins."""
+    import os
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+S_CAP_DEFAULT = 1 << 16   # crowded-sibling sort width (merge._finish)
+R_CAP_DEFAULT = 1 << 15   # run-pipeline compact width (merge._finish)
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class NodeTable:
@@ -769,7 +784,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
     skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
-    S_CAP = 1 << 16
+    S_CAP = _env_cap("GRAFT_S_CAP", S_CAP_DEFAULT)
     if S_CAP >= M:
         sib_next, first_child = _sib_links(skey, ggrp, neg_slot)
     else:
@@ -918,7 +933,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # fits, falling back to full width for adversarially fragmented
     # tours (comb-shaped logs where every token is its own run).  Both
     # branches produce the same [7, M] expansion.
-    R_CAP = 1 << 15
+    R_CAP = _env_cap("GRAFT_R_CAP", R_CAP_DEFAULT)
     if R_CAP >= T:
         ex = _expand(run_s, run_e)
     else:

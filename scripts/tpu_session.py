@@ -115,6 +115,37 @@ def phase5():
     out({"phase": 5, "scale": rows})
 
 
+def phase6():
+    """Static-cap tuning on chip (VERDICT r3 next-8): sweep GRAFT_S_CAP
+    over the descending-chains config (the only remaining sort user)
+    and GRAFT_R_CAP over the comb config (fragmented tour), timing each
+    setting honestly.  Caps are read at trace time, so each setting
+    clears the jit caches first; the compilation cache still reuses
+    across sessions per value."""
+    cases = [
+        ("GRAFT_S_CAP", [1 << 14, 1 << 16, 1 << 18],
+         workloads.descending_chains(4096, 1_000_000),
+         workloads.descending_expected_ts(4096, 1_000_000)),
+        ("GRAFT_R_CAP", [1 << 13, 1 << 15, 1 << 17],
+         workloads.comb_pairs(1_000_000),
+         workloads.comb_expected_ts(1_000_000)),
+    ]
+    rows = []
+    for name, values, ops, expected in cases:
+        for v in values:
+            os.environ[name] = str(v)
+            jax.clear_caches()
+            stats = runner.time_merge(ops, repeats=3, audit=False,
+                                      expected_ts=expected)
+            row = {"cap": name, "value": v, "p50_ms": stats["p50_ms"],
+                   "order_exact": stats.get("order_exact")}
+            rows.append(row)
+            log(f"{name}={v}: {stats['p50_ms']} ms")
+        os.environ.pop(name, None)
+    jax.clear_caches()
+    out({"phase": 6, "cap_sweep": rows})
+
+
 if __name__ == "__main__":
     phases = [int(a) for a in sys.argv[1:]] or [1, 2, 3]
     fns = [globals()[f"phase{p}"] for p in phases]   # typos fail fast
