@@ -451,6 +451,76 @@ class TpuTree:
                 pass    # rolled back; retry as an unordered set
         return self._apply_kernel(leaves)
 
+    def apply_wire(self, payload) -> "TpuTree":
+        """Remote apply straight from wire JSON (str or bytes).
+
+        Interactive-size deltas decode to op objects and keep the
+        sequence-semantics path of :meth:`apply`.  Bootstrap-size
+        batches skip the wire → objects → columns round trip that
+        dominated ``POST /ops`` at 1M ops (scripts/bench_service_e2e.py):
+        native parse to columns, one kernel set-join, and vectorized
+        clock bookkeeping from the columns; op objects are built once,
+        for the log.  Raises exactly what :meth:`apply` raises (the
+        service's 400/409 contract is unchanged)."""
+        from . import native
+        from .codec import json_codec
+
+        def _object_path():
+            text = payload.decode() if isinstance(payload, bytes) \
+                else payload
+            return self.apply(json_codec.loads(text))
+
+        if not native.available():
+            return _object_path()
+        return self.apply_packed(
+            native.parse_pack(payload, max_depth=self._max_depth))
+
+    def apply_packed(self, pnew: PackedOps) -> "TpuTree":
+        """Remote apply from already-packed columns (the ingest fast
+        path's second half — see :meth:`apply_wire`)."""
+        n = pnew.num_ops
+        # below the bulk kernel crossover, keep apply()'s exact
+        # sequence-semantics routing (host path / host-first)
+        if n < max(4 * DELTA_THRESHOLD, len(self._log) // 8):
+            return self.apply(op_mod.from_list(packed_mod.unpack(pnew)))
+
+        p = packed_mod.concat(self._ensure_packed(), pnew)
+        table = view_mod.to_host(merge_mod.materialize(p.arrays(),
+                                                       hints=_mode(p)))
+        n0 = len(self._log)
+        st = np.asarray(table.status)[n0:n0 + n]
+        failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
+        if failing.size:
+            k = int(failing[0])
+            bad = packed_mod.unpack(pnew)[k]
+            if st[k] == NOT_FOUND:
+                raise OperationFailedError(bad)
+            raise InvalidPathError(f"invalid path in {bad!r}")
+        leaves = packed_mod.unpack(pnew)
+        all_ok = bool(np.all(st == APPLIED))
+        applied = leaves if all_ok else \
+            [op for op, s in zip(leaves, st) if s == APPLIED]
+
+        # vectorized _record: replica clocks from the columns
+        kind = pnew.kind[:n]
+        ts_col = pnew.ts[:n]
+        add_applied = (st == APPLIED) & (kind == packed_mod.KIND_ADD)
+        rids = (ts_col[add_applied] >> 32).astype(np.int64)
+        ts_app = ts_col[add_applied]
+        for r in np.unique(rids):
+            hi = int(ts_app[rids == r].max())
+            r = int(r)
+            if hi > self._replicas.get(r, 0):
+                self._replicas[r] = hi
+        self._commit(applied, all_ok, p, table, record=False)
+        self._last_operation = Batch(tuple(applied))
+        # own-op clock: every own-replica Add in the BATCH advances it,
+        # absorbed duplicates included (apply() counts leaves the same)
+        self._timestamp += int(np.sum(
+            (kind == packed_mod.KIND_ADD) &
+            ((ts_col >> 32) == self._replica)))
+        return self
+
     def _apply_kernel(self, leaves: List[Operation]) -> List[Operation]:
         p = packed_mod.concat(self._ensure_packed(),
                               packed_mod.pack(leaves,
@@ -479,8 +549,12 @@ class TpuTree:
         self._log.extend(applied)
 
     def _commit(self, applied: List[Operation], all_applied: bool,
-                p: PackedOps, table: NodeTable) -> None:
-        self._record(applied)
+                p: PackedOps, table: NodeTable,
+                record: bool = True) -> None:
+        if record:
+            self._record(applied)
+        else:
+            self._log.extend(applied)   # clocks pre-recorded vectorized
         if applied:
             if all_applied:
                 # candidate packing == new log packing: reuse the view;
@@ -826,17 +900,36 @@ class TpuTree:
             "replicas": {str(k): v for k, v in self._replicas.items()},
             "max_depth": self._max_depth,
             "num_ops": p.num_ops,
-            "last_operation": json_codec.encode(self._last_operation),
             "hints_vouched": p.hints_vouched,
         }
+        # last_operation is (by construction of apply/batch) the ops just
+        # appended to the log, so persist the row SPAN, not the encoded
+        # blob — after a bootstrap-size merge the blob alone was larger
+        # than every column combined (73 MB at 1M ops).  Anything that
+        # breaks the suffix invariant falls back to the full encode.
+        leaves = op_mod.to_list(self._last_operation)
+        k = len(leaves)
+        tail = self._log[len(self._log) - k:] if k else []
+        if len(tail) == k and (
+                all(a is b for a, b in zip(leaves, tail))
+                or leaves == tail):
+            meta["last_op_span"] = [len(self._log) - k, len(self._log)]
+            meta["last_op_bare"] = not isinstance(self._last_operation,
+                                                  Batch)
+        else:
+            meta["last_operation"] = json_codec.encode(
+                self._last_operation)
         f = path if hasattr(path, "write") else open(path, "wb")
-        try:
+        n = p.num_ops       # capacity padding never hits the wire/disk:
+        try:                # restore re-pads to the jit bucket
             (np.savez_compressed if compress else np.savez)(
-                f, kind=p.kind, ts=p.ts, parent_ts=p.parent_ts,
-                anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
-                value_ref=p.value_ref, pos=p.pos,
-                parent_pos=p.parent_pos, anchor_pos=p.anchor_pos,
-                target_pos=p.target_pos, ts_rank=p.ts_rank,
+                f, kind=p.kind[:n], ts=p.ts[:n],
+                parent_ts=p.parent_ts[:n],
+                anchor_ts=p.anchor_ts[:n], depth=p.depth[:n],
+                paths=p.paths[:n], value_ref=p.value_ref[:n],
+                pos=p.pos[:n], parent_pos=p.parent_pos[:n],
+                anchor_pos=p.anchor_pos[:n], target_pos=p.target_pos[:n],
+                ts_rank=p.ts_rank[:n],
                 values=np.frombuffer(json.dumps(p.values).encode(),
                                      np.uint8),
                 meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
@@ -860,20 +953,33 @@ class TpuTree:
         from .codec import json_codec
         z = np.load(path)
         meta = json.loads(bytes(z["meta"]).decode())
+        # files hold exactly num_ops rows (older ones: full capacity);
+        # re-pad to the jit bucket so restored trees share trace caches
+        # with pack-produced batches
+        cols = {k: z[k] for k in
+                ("kind", "ts", "parent_ts", "anchor_ts", "depth",
+                 "paths", "value_ref", "pos")}
+        for k in ("parent_pos", "anchor_pos", "target_pos", "ts_rank"):
+            if k in z.files:
+                cols[k] = z[k]
+        cols = packed_mod.pad_arrays(
+            cols, packed_mod._bucket(max(meta["num_ops"], 1)))
         p = PackedOps(
-            kind=z["kind"], ts=z["ts"], parent_ts=z["parent_ts"],
-            anchor_ts=z["anchor_ts"], depth=z["depth"], paths=z["paths"],
-            value_ref=z["value_ref"], pos=z["pos"],
+            kind=cols["kind"], ts=cols["ts"],
+            parent_ts=cols["parent_ts"],
+            anchor_ts=cols["anchor_ts"], depth=cols["depth"],
+            paths=cols["paths"],
+            value_ref=cols["value_ref"], pos=cols["pos"],
             values=json.loads(bytes(z["values"]).decode()),
             num_ops=meta["num_ops"],
-            # older checkpoints lack hint columns: __post_init__ fills -1
-            # and the kernel's join fallback keeps semantics
-            parent_pos=z["parent_pos"] if "parent_pos" in z.files else None,
-            anchor_pos=z["anchor_pos"] if "anchor_pos" in z.files else None,
-            target_pos=z["target_pos"] if "target_pos" in z.files else None,
+            # older checkpoints lack hint columns: pad_arrays/__post_init__
+            # fill -1 and the kernel's join fallback keeps semantics
+            parent_pos=cols.get("parent_pos"),
+            anchor_pos=cols.get("anchor_pos"),
+            target_pos=cols.get("target_pos"),
             # persisted so the restore audit below covers rank staleness
             # (absent in older files: __post_init__ recomputes from ts)
-            ts_rank=z["ts_rank"] if "ts_rank" in z.files else None,
+            ts_rank=cols.get("ts_rank"),
             # provenance survives the round trip: a vouched writer's
             # complete hint columns keep restored trees on the cond-free
             # exhaustive path; absent meta (old files) stays unvouched
@@ -901,7 +1007,15 @@ class TpuTree:
             # same served snapshot must not mint colliding timestamps
             tree._timestamp = max(ts_mod.make(rid, 0),
                                   tree._replicas.get(rid, 0))
-        tree._last_operation = json_codec.decode(meta["last_operation"])
+        if "last_op_span" in meta:
+            s, e = meta["last_op_span"]
+            ops_slice = tuple(tree._log[s:e])
+            tree._last_operation = (
+                ops_slice[0] if meta.get("last_op_bare")
+                and len(ops_slice) == 1 else Batch(ops_slice))
+        else:
+            tree._last_operation = json_codec.decode(
+                meta["last_operation"])
         return tree
 
 

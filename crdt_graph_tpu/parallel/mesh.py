@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..codec.packed import KIND_PAD, PackedOps
+from ..codec.packed import pad_arrays as packed_pad_arrays
 from ..ops import merge as merge_mod
 from ..ops.merge import NodeTable
 
@@ -63,27 +64,9 @@ def make_mesh(n_docs: int = 1, n_ops: int = 1,
     return Mesh(grid, (DOCS_AXIS, OPS_AXIS))
 
 
-def _pad_ops_to(ops: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
-    """Pad the op axis to length ``n`` (pad rows are KIND_PAD zeros)."""
-    cur = ops["kind"].shape[0]
-    if cur == n:
-        return dict(ops)
-    if cur > n:
-        raise ValueError(f"op count {cur} exceeds target {n}")
-    out = {}
-    for k, v in ops.items():
-        pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
-        if k == "kind":
-            out[k] = np.pad(v, pad_width, constant_values=KIND_PAD)
-        elif k in ("value_ref", "parent_pos", "anchor_pos", "target_pos",
-                   "ts_rank"):
-            out[k] = np.pad(v, pad_width, constant_values=-1)
-        elif k == "pos":
-            out[k] = np.concatenate(
-                [v, np.arange(cur, n, dtype=v.dtype)])
-        else:
-            out[k] = np.pad(v, pad_width)
-    return out
+# canonical implementation lives with the column format (codec.packed);
+# kept under the old name for the existing call sites
+_pad_ops_to = packed_pad_arrays
 
 
 def round_up(n: int, multiple: int) -> int:

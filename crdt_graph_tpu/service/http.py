@@ -6,8 +6,10 @@ companion text editor — interoperate unmodified):
 
 - ``POST /docs/{id}/replicas``         → ``{"replica": n}``  (coordinator
   role: unique numeric replica ids, README.md:20-22)
-- ``POST /docs/{id}/ops``   body = op  → ``{"accepted": bool, "applied": op}``
-  (merge a delta; rejection = causality gap, client syncs and retries)
+- ``POST /docs/{id}/ops``   body = op  → ``{"accepted": bool,
+  "applied_count": n, "applied": op}`` (merge a delta; rejection =
+  causality gap, client syncs and retries; ``applied`` is echoed only
+  for deltas ≤ 4096 leaves — bootstrap-size pushes get the count)
 - ``GET  /docs/{id}/ops?since=ts``     → op batch (pull anti-entropy,
   CRDTree.elm:390-418; served pre-encoded by the native column encoder)
 - ``GET  /docs/{id}/snapshot``         → binary packed checkpoint (npz)
@@ -50,6 +52,7 @@ _DOC = re.compile(r"^/docs/([A-Za-z0-9_.-]+)(/.*)?$")
 
 
 DEFAULT_MAX_BODY = 128 << 20
+ECHO_LIMIT = 4096      # applied-ops echo cap (leaves); above: count only
 
 
 def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
@@ -138,15 +141,23 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                            {"replica": store.get(doc_id).assign_replica()})
                 return
             try:
-                op = store.decode_ops(body)
-            except (DecodeError, json.JSONDecodeError) as e:
+                accepted, applied = store.get(doc_id).apply_body(body)
+            except (DecodeError, json.JSONDecodeError, ValueError) as e:
+                # ValueError: the native parser's rejections (same
+                # malformed-input class as DecodeError)
                 self._send(400, {"error": str(e)})
                 return
-            accepted, applied = store.get(doc_id).apply(op)
-            self._send(200 if accepted else 409, {
-                "accepted": accepted,
-                "applied": json.loads(store.encode_ops(applied)),
-            })
+            from ..core import operation as op_mod
+            n_applied = len(op_mod.to_list(applied))
+            payload = {"accepted": accepted, "applied_count": n_applied}
+            # echo the applied ops only for interactive-scale deltas —
+            # for a bootstrap-size push, re-encoding the whole batch
+            # into the response costs multiples of the merge itself
+            # (scripts/bench_service_e2e.py) and the client already has
+            # the ops it sent
+            if n_applied <= ECHO_LIMIT:
+                payload["applied"] = json.loads(store.encode_ops(applied))
+            self._send(200 if accepted else 409, payload)
 
     return Handler
 

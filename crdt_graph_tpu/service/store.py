@@ -51,16 +51,39 @@ class Document:
         recovery contract (CRDTree.elm:104-107)."""
         leaves = list(op_mod.iter_leaves(operation))
         with self.lock:
-            try:
-                self.tree.apply(operation)
-            except CRDTError:
-                self.batches_rejected += 1
-                return False, op_mod.from_list([])
-            applied = self.tree.last_operation
-            n_applied = len(op_mod.to_list(applied))
-            self.ops_merged += n_applied
-            self.dup_absorbed += len(leaves) - n_applied
-            return True, applied
+            return self._merge(lambda: self.tree.apply(operation),
+                               len(leaves))
+
+    # wire bodies above this take the column ingest path (native parse,
+    # no per-op Python objects before the kernel)
+    WIRE_FAST_BYTES = 1 << 20
+
+    def apply_body(self, body: str) -> Tuple[bool, Operation]:
+        """Merge a raw wire body.  Small deltas decode to op objects
+        (sequence semantics, byte-for-byte the old path); bootstrap-size
+        bodies stream through the native column ingest
+        (engine.apply_wire) — the wire→objects→columns round trip
+        dominated POST /ops at headline scale
+        (scripts/bench_service_e2e.py)."""
+        from .. import native
+        if len(body) < self.WIRE_FAST_BYTES or not native.available():
+            return self.apply(DocumentStore.decode_ops(body))
+        pnew = native.parse_pack(body, max_depth=self.tree._max_depth)
+        with self.lock:
+            return self._merge(lambda: self.tree.apply_packed(pnew),
+                               pnew.num_ops)
+
+    def _merge(self, run, n_leaves: int) -> Tuple[bool, Operation]:
+        try:
+            run()
+        except CRDTError:
+            self.batches_rejected += 1
+            return False, op_mod.from_list([])
+        applied = self.tree.last_operation
+        n_applied = len(op_mod.to_list(applied))
+        self.ops_merged += n_applied
+        self.dup_absorbed += n_leaves - n_applied
+        return True, applied
 
     def operations_since(self, ts: int) -> Operation:
         with self.lock:
