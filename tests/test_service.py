@@ -229,3 +229,42 @@ def test_oversized_body_413():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_wire_fast_path_matches_object_path(monkeypatch):
+    """POST bodies route by size: >WIRE_FAST_BYTES takes the column
+    ingest (engine.apply_packed), smaller ones the object path.  Both
+    must produce identical documents, counters, and rejection behavior
+    — pinned by forcing the threshold to 0 and replaying the same
+    session through both."""
+    from crdt_graph_tpu.service.store import Document
+
+    ops1 = json_codec.dumps(crdt.Batch(tuple(
+        crdt.Add(2**32 + i + 1, (2**32 + i if i else 0,), f"v{i}")
+        for i in range(1200))))
+    # overlap + fresh tail, exercises dup absorption on the fast path
+    ops2 = json_codec.dumps(crdt.Batch(tuple(
+        crdt.Add(2**32 + i + 1, (2**32 + i if i else 0,), f"v{i}")
+        for i in range(800, 2400))))
+    orphan = json_codec.dumps(crdt.Batch(
+        tuple(crdt.Add(7 * 2**32 + i + 1, (999999 + i,), "x")
+              for i in range(1100))))
+
+    def run(fast):
+        doc = Document("d")
+        if fast:
+            monkeypatch.setattr(Document, "WIRE_FAST_BYTES", 0)
+        else:
+            monkeypatch.setattr(Document, "WIRE_FAST_BYTES", 1 << 60)
+        ok1, _ = doc.apply_body(ops1)
+        ok2, _ = doc.apply_body(ops2)
+        ok3, _ = doc.apply_body(orphan)       # causality gap -> reject
+        assert (ok1, ok2, ok3) == (True, True, False)
+        return doc.tree.visible_values(), doc.metrics()
+
+    vals_fast, m_fast = run(True)
+    vals_obj, m_obj = run(False)
+    assert vals_fast == vals_obj
+    assert m_fast == m_obj
+    assert m_fast["dup_absorbed"] == 400
+    assert m_fast["batches_rejected"] == 1
