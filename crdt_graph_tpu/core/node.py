@@ -239,6 +239,11 @@ def node_map(func: Callable[[Node], Any], parent: Node) -> List[Any]:
     return [func(n) for n in iter_visible(parent)]
 
 
+# reference-named alias (CRDTree/Node.elm `map`); node_map stays the
+# idiomatic name since `map` shadows the builtin at module scope
+map = node_map  # noqa: A001
+
+
 def filter_map(func: Callable[[Node], Any], parent: Node) -> List[Any]:
     out = []
     for n in iter_visible(parent):
