@@ -501,17 +501,23 @@ class TpuTree:
         applied = leaves if all_ok else \
             [op for op, s in zip(leaves, st) if s == APPLIED]
 
-        # vectorized _record: replica clocks from the columns
+        # vectorized _record: replica clocks from the columns.  Reference
+        # semantics are LAST-APPLIED-WINS per replica (updateTree stores
+        # each applied op's timestamp, CRDTree.elm:298-316 — which can
+        # regress a clock when a log delivers a replica's ops out of ts
+        # order), and a Delete updates the TARGET timestamp's replica
+        # (the op's ts IS the target's, Internal/Operation.elm:94-104);
+        # the packed ts column already holds exactly that per kind.
         kind = pnew.kind[:n]
         ts_col = pnew.ts[:n]
-        add_applied = (st == APPLIED) & (kind == packed_mod.KIND_ADD)
-        rids = (ts_col[add_applied] >> 32).astype(np.int64)
-        ts_app = ts_col[add_applied]
-        for r in np.unique(rids):
-            hi = int(ts_app[rids == r].max())
-            r = int(r)
-            if hi > self._replicas.get(r, 0):
-                self._replicas[r] = hi
+        idx = np.nonzero(st == APPLIED)[0]
+        ts_eff = ts_col[idx]
+        rids = ts_eff >> 32
+        uniq, inv = np.unique(rids, return_inverse=True)
+        last = np.zeros(uniq.size, np.int64)
+        np.maximum.at(last, inv, np.arange(idx.size))
+        for k in range(uniq.size):
+            self._replicas[int(uniq[k])] = int(ts_eff[last[k]])
         self._commit(applied, all_ok, p, table, record=False)
         self._last_operation = Batch(tuple(applied))
         # own-op clock: every own-replica Add in the BATCH advances it,

@@ -62,7 +62,11 @@ def random_session(seed):
 def check(seed):
     merged, ops, rng = random_session(seed)
     want = merged.visible_values()
-    p = packed.pack(ops)
+    # deep-nesting sessions exceed the default 16-deep path bucket; the
+    # kernel is depth-generic, so size the bucket from the session
+    md = max(16, max((len(op.path) for op in ops
+                      if hasattr(op, "path")), default=1))
+    p = packed.pack(ops, max_depth=md)
     for mode in (None, "exhaustive", "join"):
         t = view.to_host(merge.materialize(p.arrays(), hints=mode))
         got = view.visible_values(t, p.values)
@@ -70,7 +74,7 @@ def check(seed):
     # shuffled delivery incl. a duplicated slice
     perm = ops[:] + ops[: len(ops) // 3]
     rng.shuffle(perm)
-    p2 = packed.pack(perm)
+    p2 = packed.pack(perm, max_depth=md)
     t2 = view.to_host(merge.materialize(p2.arrays()))
     assert view.visible_values(t2, p2.values) == want, (seed, "perm+dup")
     return len(ops)
