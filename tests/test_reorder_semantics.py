@@ -111,3 +111,25 @@ def test_delete_before_its_add_fails_on_both_paths():
         with pytest.raises(crdt.OperationFailedError):
             e.apply(crdt.Batch(tuple(batch)))
         assert e.log_length == 0, count
+
+
+def test_apply_packed_keeps_the_set_semantics_contract(monkeypatch):
+    """The column ingest entry (engine.apply_packed, the POST /ops fast
+    path) is the same kernel SET regime: a fully reversed valid chain
+    converges, and a delete placed before its target's add still fails
+    the batch (d_target_later), exactly like apply()."""
+    from crdt_graph_tpu.codec import packed
+
+    n = engine.DELTA_THRESHOLD + 10
+    ops = _chain(n)
+    monkeypatch.setattr(engine, "DELTA_THRESHOLD", 0)
+    e = engine.init(1)
+    e.apply_packed(packed.pack(list(reversed(ops))))
+    assert e.visible_values() == list(range(1, n + 1))
+    assert e.log_length == n
+
+    bad = [crdt.Delete((R + 1,))] + ops      # delete precedes its add
+    e2 = engine.init(1)
+    with pytest.raises(crdt.CRDTError):
+        e2.apply_packed(packed.pack(bad))
+    assert e2.log_length == 0 and e2.visible_values() == []
