@@ -17,6 +17,10 @@ companion text editor — interoperate unmodified):
   ``POST /replicas``, restore with
   ``TpuTree.restore_packed(io.BytesIO(body), replica=id)`` (the raw
   snapshot carries the SERVER's id), then catch up with ``/ops?since=``
+- ``GET  /docs/{id}/clock``            → ``{"replicas": {rid: ts}}`` —
+  the server's vector clock; pull ``/ops?since=clock[you]`` for exactly
+  the missing suffix (server face of ``lastReplicaTimestamp``,
+  CRDTree.elm:637-639)
 - ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
 - ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
 
@@ -113,6 +117,8 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             elif sub == "/snapshot":
                 self._send_raw(200, doc.snapshot_packed(),
                                ctype="application/octet-stream")
+            elif sub == "/clock":
+                self._send(200, {"replicas": doc.clock()})
             elif sub == "/metrics":
                 self._send(200, doc.metrics())
             else:

@@ -113,6 +113,16 @@ class Document:
         with self.lock:
             return self.tree.visible_values()
 
+    def clock(self) -> Dict[str, int]:
+        """The server's vector clock (replica id → last applied ts).
+
+        Lets a client ask for exactly its missing suffix
+        (``/ops?since=clock[my_replica]``) instead of replaying from 0 —
+        the server-side face of the reference's ``lastReplicaTimestamp``
+        (CRDTree.elm:637-639)."""
+        with self.lock:
+            return {str(r): ts for r, ts in self.tree._replicas.items()}
+
     def metrics(self) -> Dict[str, int]:
         with self.lock:
             return {

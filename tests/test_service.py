@@ -268,3 +268,22 @@ def test_wire_fast_path_matches_object_path(monkeypatch):
     assert m_fast == m_obj
     assert m_fast["dup_absorbed"] == 400
     assert m_fast["batches_rejected"] == 1
+
+
+def test_clock_endpoint_enables_minimal_sync(server, req):
+    """GET /clock exposes the server's vector clock; a client pulls
+    exactly its missing suffix instead of replaying from 0."""
+    a = TextBuffer(3)
+    a.insert(0, "abc")
+    req(server, "POST", "/docs/ck/ops", json_codec.dumps(a.operations_since(0)))
+    st, out = req(server, "GET", "/docs/ck/clock")
+    assert st == 200
+    last = out["replicas"]["3"]
+    assert last == a.last_replica_timestamp(3)
+    # nothing new since the clock value: empty suffix, not a full replay
+    _, ops = req(server, "GET", f"/docs/ck/ops?since={last}")
+    assert len(ops["ops"]) == 1          # the inclusive terminator only
+    a.insert(3, "d")
+    req(server, "POST", "/docs/ck/ops", json_codec.dumps(a.last_operation))
+    _, ops = req(server, "GET", f"/docs/ck/ops?since={last}")
+    assert len(ops["ops"]) == 2          # terminator + the new edit
