@@ -33,7 +33,7 @@ def _as_arrays(workload) -> Dict[str, np.ndarray]:
     return packed_mod.pack(workload).arrays()
 
 
-def _summary_fn(no_deletes: bool = False):
+def _summary_fn(no_deletes: bool = False, hints=None):
     """Jitted merge returning only small dependent outputs: a fingerprint
     over the order-defining fields plus the node/visible counts — and,
     when an expected sequence rides along (call arity specializes the jit
@@ -42,7 +42,7 @@ def _summary_fn(no_deletes: bool = False):
     compile time.  One dispatch, one tiny readback.  ``no_deletes`` is
     the host-checked static promise from time_merge."""
     def fn(ops, *expected):
-        t = merge._materialize(ops, no_deletes=no_deletes)
+        t = merge._materialize(ops, None, hints, no_deletes)
         fp = honest.fingerprint(
             (t.doc_index, t.visible_order, t.status, t.ts))
         if expected:
@@ -66,10 +66,16 @@ def _summary_fn(no_deletes: bool = False):
 
 def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
                progress: bool = False, audit: bool = True,
-               expected_ts: Optional[np.ndarray] = None) -> dict:
+               expected_ts: Optional[np.ndarray] = None,
+               hints: Optional[str] = None) -> dict:
     """Compile, warm up, and honestly time the jitted merge.  With
     ``expected_ts``, every repeat also checks the full visible sequence
-    against it on device (``order_exact`` in the result)."""
+    against it on device (``order_exact`` in the result).  ``hints``
+    selects the kernel mode: "exhaustive" benches the engine's
+    production path for provenance-vouched batches (the bench
+    generators build exact hints by construction, and the fused order
+    check still gates the RESULT independently — a wrong hint would
+    fail it, not pass silently)."""
     def _log(msg: str) -> None:
         if progress:
             print(f"bench: {msg}", file=sys.stderr, flush=True)
@@ -83,7 +89,7 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
             (dev_ops, jax.device_put(expected_ts))
     _log("arrays on device")
     fn = _summary_fn(no_deletes=merge.host_no_deletes(
-        np.asarray(ops["kind"])))
+        np.asarray(ops["kind"])), hints=hints)
     stats = honest.time_with_readback(fn, *args, repeats=repeats, log=_log)
     _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
