@@ -216,3 +216,40 @@ def test_restore_repairs_stale_vouch(tmp_path):
     tamper(mislink)
     t.checkpoint_packed(path)
     tamper(rank_swap)
+
+
+def test_restore_reads_old_format_checkpoints(tmp_path):
+    """r3-format checkpoints carried FULL-CAPACITY columns, an encoded
+    last_operation blob, and no ts_rank file; restore must still read
+    them (pad/span/rank branches all have a legacy side)."""
+    import json as _json
+    from crdt_graph_tpu.codec import json_codec
+
+    t = engine.init(8)
+    for i in range(5):
+        t.add(f"w{i}")
+    p = t._ensure_packed()
+    meta = {
+        "replica": 8, "timestamp": t.timestamp,
+        "cursor": list(t.cursor),
+        "replicas": {str(k): v for k, v in t._replicas.items()},
+        "max_depth": 16, "num_ops": p.num_ops,
+        "last_operation": json_codec.encode(t.last_operation),
+        "hints_vouched": True,
+    }
+    path = str(tmp_path / "old.npz")
+    with open(path, "wb") as f:
+        np.savez_compressed(                      # full capacity, no rank
+            f, kind=p.kind, ts=p.ts, parent_ts=p.parent_ts,
+            anchor_ts=p.anchor_ts, depth=p.depth, paths=p.paths,
+            value_ref=p.value_ref, pos=p.pos,
+            parent_pos=p.parent_pos, anchor_pos=p.anchor_pos,
+            target_pos=p.target_pos,
+            values=np.frombuffer(_json.dumps(p.values).encode(), np.uint8),
+            meta=np.frombuffer(_json.dumps(meta).encode(), np.uint8))
+    back = engine.TpuTree.restore_packed(path)
+    assert back.visible_values() == t.visible_values()
+    assert back.last_operation == t.last_operation
+    assert back.timestamp == t.timestamp
+    back.add("after")
+    assert "after" in back.visible_values()
