@@ -895,7 +895,15 @@ class TpuTree:
         file-like object (the service's snapshot wire format streams
         this into the HTTP response).  ``compress=False`` trades ~6x
         size for ~10x less encode time — the wire-snapshot choice,
-        where the document lock is held while encoding."""
+        where the document lock is held while encoding.
+
+        Format note (ADVICE r4): since r4 the ``last_operation`` blob is
+        omitted whenever the tail-span invariant holds (``last_op_span``
+        replaces it), so r4+ checkpoints are NOT readable by r3-era
+        ``restore_packed`` (KeyError on ``last_operation``).  Old
+        checkpoints remain readable by new code
+        (tests/test_checkpoint_compat.py); snapshot wire-format
+        consumers must run the r4+ restore."""
         import json
         from .codec import json_codec
         p = self._ensure_packed()

@@ -142,10 +142,17 @@ def _env_cap(name: str, default: int) -> int:
     GRAFT_R_CAP) so the on-chip tuning session can sweep the caps
     without code edits.  Read at TRACE time: a sweep changing the env
     under identical shapes/static-args must ``jax.clear_caches()`` (or
-    use a fresh process) between settings, or the cached trace wins."""
+    use a fresh process) between settings, or the cached trace wins —
+    the effective value is logged on every (re)trace so a stale-cache
+    sweep is detectable in the log (ADVICE r4)."""
+    import logging
     import os
     v = os.environ.get(name)
-    return int(v) if v else default
+    cap = int(v) if v else default
+    logging.getLogger(__name__).info(
+        "trace-time cap %s=%d%s", name, cap,
+        "" if v else " (default)")
+    return cap
 
 
 S_CAP_DEFAULT = 1 << 16   # crowded-sibling sort width (merge._finish)

@@ -84,9 +84,21 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 return None, url.path, parse_qs(url.query)
             return m.group(1), (m.group(2) or ""), parse_qs(url.query)
 
-        def _body(self) -> str:
-            n = int(self.headers.get("Content-Length", 0))
-            return self.rfile.read(n).decode()
+        def _content_length(self) -> Optional[int]:
+            """Content-Length as an int, or None (with a 400 already
+            sent) when the header is malformed — int() raising inside
+            the handler would abort the connection instead of
+            answering (ADVICE r4)."""
+            raw = self.headers.get("Content-Length", 0)
+            try:
+                return int(raw)
+            except ValueError:
+                self.close_connection = True
+                self._send(400, {"error": "malformed Content-Length"})
+                return None
+
+        def _body(self, n: int) -> bytes:
+            return self.rfile.read(n)
 
         def do_GET(self):
             doc_id, sub, query = self._route()
@@ -128,7 +140,10 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # reject oversized bodies before reading them (the connection
             # closes: unread body bytes would otherwise be parsed as the
             # next request line on keep-alive)
-            if int(self.headers.get("Content-Length", 0)) > max_body:
+            n = self._content_length()
+            if n is None:
+                return
+            if n > max_body:
                 self.close_connection = True
                 self._send(413, {"error": f"body exceeds {max_body} "
                                           "bytes; chunk the batch"})
@@ -137,7 +152,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # would otherwise read leftover body bytes as the next request
             # line), and validate the route BEFORE store.get(create=True)
             # so invalid requests never materialize documents
-            body = self._body()
+            body = self._body(n)
             doc_id, sub, _ = self._route()
             if doc_id is None or sub not in ("/replicas", "/ops"):
                 self._send(404, {"error": "not found"})

@@ -58,14 +58,18 @@ class Document:
     # no per-op Python objects before the kernel)
     WIRE_FAST_BYTES = 1 << 20
 
-    def apply_body(self, body: str) -> Tuple[bool, Operation]:
-        """Merge a raw wire body.  Small deltas decode to op objects
+    def apply_body(self, body) -> Tuple[bool, Operation]:
+        """Merge a raw wire body (``bytes`` as read off the socket, or
+        ``str``; the threshold is in BYTES, so handlers should pass the
+        undecoded body — ADVICE r4).  Small deltas decode to op objects
         (sequence semantics, byte-for-byte the old path); bootstrap-size
         bodies stream through the native column ingest
         (engine.apply_wire) — the wire→objects→columns round trip
         dominated POST /ops at headline scale
         (scripts/bench_service_e2e.py)."""
         from .. import native
+        if isinstance(body, str):
+            body = body.encode()
         if len(body) < self.WIRE_FAST_BYTES or not native.available():
             return self.apply(DocumentStore.decode_ops(body))
         pnew = native.parse_pack(body, max_depth=self.tree._max_depth)
@@ -162,5 +166,6 @@ class DocumentStore:
         return json_codec.dumps(op)
 
     @staticmethod
-    def decode_ops(payload: str) -> Operation:
+    def decode_ops(payload) -> Operation:
+        """Wire JSON (str or bytes) → Operation."""
         return json_codec.loads(payload)

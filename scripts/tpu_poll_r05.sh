@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-5 tunnel poll: one 60s TPU attempt every ~4 min, up to 150 tries
+# (~10h — bounded to end BEFORE the driver's round-end bench window; see
+# memory: a stray probe client can deadlock the grant against the
+# driver's own attempt).  Exits 0 the moment a probe succeeds (marker
+# /tmp/tpu_ok), 1 when the budget is exhausted.
+LOG=/tmp/tpu_poll_r05.log
+rm -f /tmp/tpu_ok
+for i in $(seq 1 150); do
+  echo "r05 probe $i $(date +%H:%M:%S)" >> "$LOG"
+  if timeout 60 python -c "
+import numpy as np, jax, jax.numpy as jnp
+x = jax.device_put(np.arange(8, dtype=np.int32))
+print(int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v+1))(x)))))
+" >> "$LOG" 2>&1; then
+    touch /tmp/tpu_ok
+    echo "TPU OK at $(date +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  sleep 180
+done
+echo "r05: TPU never granted" >> "$LOG"
+exit 1

@@ -231,6 +231,28 @@ def test_oversized_body_413():
         srv.server_close()
 
 
+def test_malformed_content_length_400():
+    """A non-numeric Content-Length answers 400 instead of aborting the
+    connection with an uncaught ValueError (ADVICE r4)."""
+    import socket
+    import threading
+    from crdt_graph_tpu.service import make_server
+
+    srv = make_server(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.server_port),
+                                     timeout=30)
+        s.sendall(b"POST /docs/cl/ops HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Length: abc\r\n\r\n")
+        data = s.recv(4096)
+        assert b"400" in data.split(b"\r\n", 1)[0]
+        s.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_wire_fast_path_matches_object_path(monkeypatch):
     """POST bodies route by size: >WIRE_FAST_BYTES takes the column
     ingest (engine.apply_packed), smaller ones the object path.  Both
