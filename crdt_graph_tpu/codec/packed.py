@@ -170,7 +170,7 @@ def compute_ts_rank(kind: np.ndarray, ts: np.ndarray) -> np.ndarray:
     return rank
 
 
-def verify_hints(p: PackedOps) -> bool:
+def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
     """Host-side audit that the hint columns carry exactly what the
     kernel's "exhaustive" mode assumes (ADVICE r3: a restore must not
     trust a persisted vouch over possibly stale/corrupt columns).
@@ -183,8 +183,15 @@ def verify_hints(p: PackedOps) -> bool:
     the kernel's auto mode re-derives on device (ops/merge.py rank/link
     verification); when they hold, exhaustive and auto are semantically
     identical, so a batch passing this check may keep the cond-free
-    path."""
-    if not np.array_equal(p.ts_rank, compute_ts_rank(p.kind, p.ts)):
+    path.
+
+    ``check_rank=False`` skips (a) — for callers whose PackedOps was
+    built WITHOUT a ts_rank column (``__post_init__`` computed it from
+    the same kind/ts the check would recompute from, so the comparison
+    is tautologically true); persisted/foreign ts_rank columns (restore)
+    must keep the default."""
+    if check_rank and not np.array_equal(p.ts_rank,
+                                         compute_ts_rank(p.kind, p.ts)):
         return False
     n = p.capacity
     is_add = p.kind == KIND_ADD
@@ -194,7 +201,13 @@ def verify_hints(p: PackedOps) -> bool:
         nonzero = active & (want > 0) & (want < MAX_TS)
         h = np.clip(hint, 0, n - 1)
         verified = (hint >= 0) & (hint < n) & is_add[h] & (p.ts[h] == want)
-        in_batch = np.isin(want, uniq)
+        if uniq.size:
+            # membership by binary search — uniq is sorted; np.isin's
+            # sort-based path re-sorted both sides per call
+            i = np.minimum(np.searchsorted(uniq, want), uniq.size - 1)
+            in_batch = uniq[i] == want
+        else:
+            in_batch = np.zeros(want.shape, bool)
         return bool(np.all(~(nonzero & in_batch) | verified))
 
     return (_refs_ok(p.kind != KIND_PAD, p.parent_ts, p.parent_pos)
@@ -367,28 +380,78 @@ def pack(ops, max_depth: int = DEFAULT_MAX_DEPTH,
 
 
 def unpack(packed: PackedOps) -> List[Operation]:
-    """Packed arrays → operation list (inverse of :func:`pack`).
+    """Packed arrays → operation list (inverse of :func:`pack`)."""
+    return unpack_rows(packed, 0, packed.num_ops)
+
+
+def unpack_rows(packed: PackedOps, start: int, stop: int
+                ) -> List[Operation]:
+    """Operation objects for rows ``[start, stop)`` only — the columnar
+    log (oplog.OpLog) materializes small suffixes through this without
+    touching the rest.
 
     Columns convert once via ``.tolist()`` (C-speed, native ints) so the
     per-row work is only slicing and constructing the frozen op — at 1M
     rows the naive per-element numpy indexing was ~3x slower and sat on
     the serving ingest path (engine.apply_packed)."""
-    n = packed.num_ops
-    kind = packed.kind[:n].tolist()
-    ts = packed.ts[:n].tolist()
-    depth = packed.depth[:n].tolist()
-    paths = packed.paths[:n].tolist()
-    vref = packed.value_ref[:n].tolist()
+    start = max(start, 0)
+    stop = min(stop, packed.num_ops)
+    if stop <= start:
+        return []
+    kind = packed.kind[start:stop].tolist()
+    ts = packed.ts[start:stop].tolist()
+    depth = packed.depth[start:stop].tolist()
+    paths = packed.paths[start:stop].tolist()
+    vref = packed.value_ref[start:stop].tolist()
     values = packed.values
     out: List[Operation] = []
     append = out.append
-    for i in range(n):
+    for i in range(stop - start):
         k = kind[i]
         path = tuple(paths[i][:depth[i]])
         if k == KIND_ADD:
             append(Add(ts[i], path, values[vref[i]]))
         elif k == KIND_DELETE:
             append(Delete(path))
+    return out
+
+
+def select_rows(p: PackedOps, idx: np.ndarray) -> PackedOps:
+    """A new self-contained PackedOps holding rows ``idx`` of ``p`` (in
+    that order): the columnar face of "keep only the APPLIED subset" on
+    the partial-absorb ingest path (engine.apply_packed), where the old
+    code unpacked the whole batch to filter objects.  Values are
+    subset and renumbered; hints are rebuilt from the surviving rows
+    (vectorized), so the result is vouched by construction."""
+    idx = np.asarray(idx, dtype=np.int64)
+    n = int(idx.size)
+    cap = _bucket(n)
+    depth = p.depth[idx] if n else np.zeros(0, np.int32)
+    width = _depth_bucket(int(depth.max()) if n else 1, p.max_depth)
+
+    vr = p.value_ref[idx]
+    has_val = vr >= 0
+    values = [p.values[j] for j in vr[has_val].tolist()]
+    new_vref = np.full(cap, -1, dtype=np.int32)
+    new_vref[:n][has_val] = np.arange(len(values), dtype=np.int32)
+
+    out = PackedOps(
+        kind=np.full(cap, KIND_PAD, dtype=np.int8),
+        ts=np.zeros(cap, dtype=np.int64),
+        parent_ts=np.zeros(cap, dtype=np.int64),
+        anchor_ts=np.zeros(cap, dtype=np.int64),
+        depth=np.zeros(cap, dtype=np.int32),
+        paths=np.zeros((cap, width), dtype=np.int64),
+        value_ref=new_vref,
+        pos=np.arange(cap, dtype=np.int32),
+        values=values, num_ops=n)
+    out.kind[:n] = p.kind[idx]
+    out.ts[:n] = p.ts[idx]
+    out.parent_ts[:n] = p.parent_ts[idx]
+    out.anchor_ts[:n] = p.anchor_ts[idx]
+    out.depth[:n] = depth
+    out.paths[:n] = p.paths[idx][:, :width]
+    rebuild_hints(out)
     return out
 
 
@@ -402,7 +465,16 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     ``pos == array index`` (the ``pos`` column feeds status/absorption
     ordering, not dedup).  Differing path widths (depth buckets) widen
     to the larger.
+
+    An empty side returns the other side UNCOPIED (the fresh-document
+    bootstrap ingests a 1M-op batch through here; a full column copy
+    plus index rebuild was ~2.5 s of the warm serving path).  Callers
+    treat PackedOps as immutable either way.
     """
+    if a.num_ops == 0:
+        return b
+    if b.num_ops == 0:
+        return a
     n = a.num_ops + b.num_ops
     cap = _bucket(n)
     width = max(a.max_depth, b.max_depth)
@@ -434,10 +506,11 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     # the kernel's hinted path relies on "every in-batch reference has a
     # hint" (ops/merge.py step 4).  Typical anti-entropy (old log + new
     # delta) leaves a's unresolved set empty, so the extra pass is O(new
-    # cross-references), not O(log).
-    a_index, b_index = a.index(), b.index()
-
-    def _fill(side, other_index, base, other_base, count):
+    # cross-references), not O(log) — and the other side's index is only
+    # BUILT when some ref actually needs it (a fully-internal 1M batch
+    # paid ~0.8 s of dict construction here for zero lookups).
+    def _fill(side, other, base, other_base, count):
+        other_index = None
         for name, ref_col in (("parent_pos", "parent_ts"),
                               ("anchor_pos", "anchor_ts"),
                               ("target_pos", "ts")):
@@ -449,16 +522,20 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
                 unresolved &= side.kind[:count] == KIND_DELETE
             elif name == "anchor_pos":
                 unresolved &= side.kind[:count] == KIND_ADD
-            for i in np.nonzero(unresolved & (refs != 0))[0]:
-                hit = other_index.get(int(refs[i]))
-                h[i] = hit + other_base if hit is not None else -1
+            rows = np.nonzero(unresolved & (refs != 0))[0]
+            if rows.size:
+                if other_index is None:
+                    other_index = other.index()
+                for i in rows:
+                    hit = other_index.get(int(refs[i]))
+                    h[i] = hit + other_base if hit is not None else -1
             getattr(out, name)[base:base + count] = h
 
-    _fill(a, b_index, 0, na, na)
-    _fill(b, a_index, na, 0, nb)
-    out.ts_index = dict(a_index)
-    for t, i in b_index.items():
-        out.ts_index.setdefault(t, i + na)
+    _fill(a, b, 0, na, na)
+    _fill(b, a, na, 0, nb)
+    # merged ts index stays lazy (PackedOps.index builds it vectorized
+    # on first use) — eagerly merging two million-entry dicts was the
+    # single largest cost of the warm bootstrap ingest
     # rank hints cover the union (post_init saw only padding rows); the
     # cross-fill above preserves link-hint completeness only if both
     # sides had it

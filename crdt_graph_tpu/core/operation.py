@@ -120,6 +120,17 @@ def since(ts: int, operations: list) -> list:
     return []
 
 
+def count(op: Operation) -> int:
+    """Leaf count of an operation, without materializing lazy batches
+    (oplog.PackedBatch exposes ``num_leaves``; a plain Batch recurses)."""
+    n = getattr(op, "num_leaves", None)
+    if n is not None:
+        return n
+    if isinstance(op, Batch):
+        return sum(count(child) for child in op.ops)
+    return 1
+
+
 def iter_leaves(op: Operation) -> Iterator[Operation]:
     """Depth-first iteration over the non-Batch leaves of an operation."""
     if isinstance(op, Batch):

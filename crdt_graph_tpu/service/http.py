@@ -169,7 +169,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 self._send(400, {"error": str(e)})
                 return
             from ..core import operation as op_mod
-            n_applied = len(op_mod.to_list(applied))
+            n_applied = op_mod.count(applied)
             payload = {"accepted": accepted, "applied_count": n_applied}
             # echo the applied ops only for interactive-scale deltas —
             # for a bootstrap-size push, re-encoding the whole batch

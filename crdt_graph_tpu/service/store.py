@@ -84,7 +84,7 @@ class Document:
             self.batches_rejected += 1
             return False, op_mod.from_list([])
         applied = self.tree.last_operation
-        n_applied = len(op_mod.to_list(applied))
+        n_applied = op_mod.count(applied)
         self.ops_merged += n_applied
         self.dup_absorbed += n_leaves - n_applied
         return True, applied

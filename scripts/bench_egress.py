@@ -59,7 +59,8 @@ def main(n: int = 1_000_000) -> None:
 
     from crdt_graph_tpu import engine
     t = engine.init(1)
-    t._log = list(ops)
+    t._log = engine.OpLog()
+    t._log.extend_packed(p)
     t._packed = p
     for compress in (True, False):
         t0 = time.perf_counter()

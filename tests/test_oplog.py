@@ -1,0 +1,230 @@
+"""Columnar op log (crdt_graph_tpu/oplog.py) — VERDICT r4 next-5.
+
+The log is the replica state, so its columnar form must be
+indistinguishable from the object list it replaced: same iteration
+order, same ``operations_since`` suffixes, same rollback, same
+checkpoint round trips — while the bulk ingest path builds zero per-op
+Python objects (pinned here by counting materializations).
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from crdt_graph_tpu import engine
+from crdt_graph_tpu.codec import packed as packed_mod
+from crdt_graph_tpu.core.operation import Add, Batch, Delete
+from crdt_graph_tpu.core import operation as op_mod
+from crdt_graph_tpu.oplog import OpLog, PackedBatch
+
+
+def ts(r, c):
+    return r * 2**32 + c
+
+
+def chain_ops(r, n, start=1):
+    """n adds by replica r, each anchored on the previous."""
+    out = []
+    prev = 0
+    for c in range(start, start + n):
+        out.append(Add(ts(r, c), (prev,), f"v{r}.{c}"))
+        prev = ts(r, c)
+    return out
+
+
+def test_mixed_segments_iterate_in_order():
+    objs1 = chain_ops(1, 5)
+    packed_seg = packed_mod.pack(chain_ops(2, 7), max_depth=4)
+    objs2 = [Delete((ts(1, 5),))]
+    log = OpLog(objs1)
+    log.extend_packed(packed_seg)
+    log.extend(objs2)
+    expect = objs1 + packed_mod.unpack(packed_seg) + objs2
+    assert len(log) == len(expect)
+    assert list(log) == expect
+    assert log[5] == expect[5]
+    assert log[-1] == expect[-1]
+    assert log[3:9] == expect[3:9]
+
+
+def test_truncate_inside_packed_segment():
+    log = OpLog(chain_ops(1, 3))
+    p = packed_mod.pack(chain_ops(2, 6), max_depth=4)
+    log.extend_packed(p)
+    full = list(log)
+    log.truncate(5)
+    assert len(log) == 5
+    assert list(log) == full[:5]
+    # the packed tail beyond the cut never reappears
+    log.extend([Delete((ts(1, 1),))])
+    assert list(log) == full[:5] + [Delete((ts(1, 1),))]
+
+
+def test_index_of_add_spans_segments():
+    objs = chain_ops(1, 4)
+    p = packed_mod.pack(chain_ops(2, 4), max_depth=4)
+    log = OpLog(objs)
+    log.extend_packed(p)
+    assert log.index_of_add(ts(1, 3)) == 2
+    assert log.index_of_add(ts(2, 2)) == 5
+    assert log.index_of_add(ts(9, 9)) is None
+    # deletes never terminate the scan (only Adds index)
+    log.extend([Delete((ts(2, 4),))])
+    assert log.index_of_add(ts(2, 4)) == 7
+
+
+def test_to_packed_matches_full_pack():
+    objs = chain_ops(1, 5)
+    tail = chain_ops(2, 5)
+    log = OpLog(objs)
+    log.extend_packed(packed_mod.pack(tail, max_depth=4))
+    a = log.to_packed(max_depth=4)
+    b = packed_mod.pack(objs + tail, max_depth=4)
+    assert a.num_ops == b.num_ops
+    for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth"):
+        np.testing.assert_array_equal(
+            getattr(a, name)[:a.num_ops], getattr(b, name)[:b.num_ops])
+    assert packed_mod.unpack(a) == packed_mod.unpack(b)
+    assert a.hints_vouched
+    assert packed_mod.verify_hints(a)
+
+
+def test_packed_batch_is_lazy_and_counts():
+    p = packed_mod.pack(chain_ops(3, 8), max_depth=4)
+    pb = PackedBatch(p)
+    assert op_mod.count(pb) == 8
+    assert pb._ops is None, "count must not materialize"
+    assert isinstance(pb, Batch)
+    # equality across the class boundary, both directions
+    plain = Batch(tuple(packed_mod.unpack(p)))
+    assert pb == plain and plain == pb
+    assert pb.ops == plain.ops
+
+
+def test_bulk_ingest_stays_columnar():
+    """A bootstrap-size apply_packed extends the log by a COLUMN
+    segment and wraps the result lazily — no object materialization."""
+    ops = chain_ops(1, 2000)
+    pnew = packed_mod.pack(ops, max_depth=4)
+    t = engine.init(0)
+    t.apply_packed(pnew)
+    assert isinstance(t.last_operation, PackedBatch)
+    assert t.last_operation._ops is None
+    assert op_mod.count(t.last_operation) == 2000
+    seg = t._log._segs[-1]
+    assert not isinstance(seg, list), "log tail must be a column segment"
+    # suffix pull materializes only the asked-for rows
+    suffix = t.operations_since(ts(1, 1999))
+    assert [op.ts for op in suffix.ops] == [ts(1, 1999), ts(1, 2000)]
+    assert t.operations_since(ts(7, 1)) == Batch(())
+
+
+def test_bulk_ingest_partial_absorb_columnar():
+    """Redelivered rows absorb; only the applied subset enters the log
+    (as columns), and the document matches the object-path result."""
+    ops = chain_ops(1, 1500)
+    t = engine.init(0)
+    t.apply_packed(packed_mod.pack(ops, max_depth=4))
+    # redeliver the tail 1100 plus 1100 genuinely new ops
+    new = chain_ops(1, 1100, start=1501)
+    t.apply_packed(packed_mod.pack(ops[-1100:] + new, max_depth=4))
+    assert t.log_length == 2600
+    assert op_mod.count(t.last_operation) == 1100
+    oracle = engine.init(0)
+    oracle.apply(op_mod.from_list(ops + new))
+    assert t.visible_values() == oracle.visible_values()
+    # clocks agree with the object path
+    assert t._replicas == oracle._replicas
+
+
+def test_bulk_reject_reports_first_failing_op():
+    t = engine.init(0)
+    t.apply_packed(packed_mod.pack(chain_ops(1, 1200), max_depth=4))
+    bad = chain_ops(2, 1100) + [Add(ts(3, 1), (ts(9, 9),), "orphan")]
+    with pytest.raises(engine.OperationFailedError):
+        t.apply_packed(packed_mod.pack(bad, max_depth=4))
+    assert t.log_length == 1200, "rejected batch must not mutate state"
+
+
+def test_checkpoint_span_roundtrip_columnar():
+    """Binary checkpoint after a columnar commit takes the O(1)
+    last_op_span path and restores to an equal tree."""
+    t = engine.init(0)
+    t.apply_packed(packed_mod.pack(chain_ops(1, 1500), max_depth=4))
+    buf = io.BytesIO()
+    t.checkpoint_packed(buf, compress=False)
+    buf.seek(0)
+    z = np.load(buf)
+    meta = json.loads(bytes(z["meta"]).decode())
+    assert meta["last_op_span"] == [0, 1500]
+    assert "last_operation" not in meta
+    buf.seek(0)
+    r = engine.TpuTree.restore_packed(buf)
+    assert r.log_length == 1500
+    assert isinstance(r.last_operation, PackedBatch)
+    assert r.visible_values() == t.visible_values()
+    assert list(r._log) == list(t._log)
+
+
+def test_corrupt_hint_checkpoint_never_reaches_cond_free(monkeypatch):
+    """VERDICT r4 next-7: a checkpoint whose persisted hint columns are
+    corrupt (but still vouched) must be repaired at restore BEFORE any
+    merge — with the GRAFT_DEBUG_VOUCH tripwire UNSET, so the guarantee
+    holds in production mode, not just under the test harness."""
+    monkeypatch.delenv("GRAFT_DEBUG_VOUCH", raising=False)
+    t = engine.init(0)
+    t.apply_packed(packed_mod.pack(chain_ops(1, 1500), max_depth=4))
+    buf = io.BytesIO()
+    t.checkpoint_packed(buf, compress=False)
+    # tamper: point every parent/anchor hint at row 0 and shuffle ranks,
+    # keeping the vouch flag — a hand-edited / bit-rotted snapshot
+    buf.seek(0)
+    z = np.load(buf)
+    cols = {k: z[k].copy() for k in z.files}
+    n = len(cols["kind"])
+    cols["anchor_pos"][:] = 0
+    cols["parent_pos"][:] = 0
+    cols["ts_rank"][:n // 2] = np.arange(n // 2, dtype=np.int32)[::-1]
+    evil = io.BytesIO()
+    np.savez(evil, **cols)
+    evil.seek(0)
+    r = engine.TpuTree.restore_packed(evil)
+    # the restore audit rebuilt the hints: the packed state verifies,
+    # stays vouched (cond-free mode is SAFE again), and a follow-up
+    # merge converges with the object path
+    assert r._packed.hints_vouched
+    assert packed_mod.verify_hints(r._packed)
+    more = chain_ops(2, 1100)
+    r.apply_packed(packed_mod.pack(more, max_depth=4))
+    oracle = engine.init(0)
+    oracle.apply(op_mod.from_list(chain_ops(1, 1500) + more))
+    assert r.visible_values() == oracle.visible_values()
+
+
+def test_wire_ingest_audit_repairs_bad_parser_hints(monkeypatch):
+    """VERDICT r4 next-7, wire face: if the native parser ever emitted
+    wrong hint columns, the default-on ingest audit rebuilds them before
+    the batch can reach the cond-free kernel mode."""
+    from crdt_graph_tpu import native
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    monkeypatch.delenv("GRAFT_DEBUG_VOUCH", raising=False)
+    real = native.load().parse_pack
+
+    def corrupting(payload, max_depth):
+        cols = dict(real(payload, max_depth))
+        bad = np.frombuffer(cols["anchor_pos"], np.int32).copy()
+        bad[:] = 0          # simulated parser bug
+        cols["anchor_pos"] = bad.tobytes()
+        return cols
+
+    import types
+    monkeypatch.setattr(native, "_mod",
+                        types.SimpleNamespace(parse_pack=corrupting))
+    from crdt_graph_tpu.codec import json_codec
+    ops = chain_ops(1, 600)
+    p = native.parse_pack(json_codec.dumps(op_mod.from_list(ops)))
+    assert p.hints_vouched
+    assert packed_mod.verify_hints(p), "ingest audit must repair hints"
+    assert packed_mod.unpack(p) == ops
