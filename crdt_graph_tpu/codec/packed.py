@@ -544,6 +544,89 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     return out
 
 
+def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
+    """Union of several packed batches in ONE allocation — the columnar
+    log's full-state export (oplog.OpLog.to_packed), where a pairwise
+    concat fold re-copied the growing prefix per segment (O(s·n) row
+    copies for s segments).
+
+    Row order is part order (first-arrival dedup matches sequential
+    application, as in :func:`concat`).  Each part keeps its internal
+    link hints (shifted); refs a part could not resolve internally are
+    looked up in a merged cross-part index, built lazily only when some
+    ref actually needs it.  A hint may point at any add row carrying
+    the referenced timestamp — the kernel verifies ``ts[hint] == want``
+    and elects the canonical duplicate itself — so cross-part duplicate
+    timestamps need no special casing."""
+    parts = [p for p in parts if p.num_ops]
+    if not parts:
+        return pack([])
+    if len(parts) == 1:
+        return parts[0]
+    n = sum(p.num_ops for p in parts)
+    cap = _bucket(n)
+    width = max(p.max_depth for p in parts)
+    values: List[Any] = []
+    out = PackedOps(
+        kind=np.full(cap, KIND_PAD, dtype=np.int8),
+        ts=np.zeros(cap, dtype=np.int64),
+        parent_ts=np.zeros(cap, dtype=np.int64),
+        anchor_ts=np.zeros(cap, dtype=np.int64),
+        depth=np.zeros(cap, dtype=np.int32),
+        paths=np.zeros((cap, width), dtype=np.int64),
+        value_ref=np.full(cap, -1, dtype=np.int32),
+        pos=np.arange(cap, dtype=np.int32),
+        values=values, num_ops=n)
+
+    merged_index: Optional[dict] = None
+
+    def _cross_index() -> dict:
+        nonlocal merged_index
+        if merged_index is None:
+            merged_index = {}
+            b = 0
+            for q in parts:
+                for t, i in q.index().items():
+                    merged_index.setdefault(t, i + b)
+                b += q.num_ops
+        return merged_index
+
+    base = 0
+    for p in parts:
+        k = p.num_ops
+        for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth"):
+            getattr(out, name)[base:base + k] = getattr(p, name)[:k]
+        out.paths[base:base + k, :p.max_depth] = p.paths[:k]
+        shifted = p.value_ref[:k].copy()
+        shifted[shifted >= 0] += len(values)
+        out.value_ref[base:base + k] = shifted
+        values.extend(p.values)
+
+        for name, ref_col in (("parent_pos", "parent_ts"),
+                              ("anchor_pos", "anchor_ts"),
+                              ("target_pos", "ts")):
+            h = getattr(p, name)[:k].copy()
+            refs = getattr(p, ref_col)[:k]
+            unresolved = h < 0
+            h[~unresolved] += base
+            if name == "target_pos":
+                unresolved &= p.kind[:k] == KIND_DELETE
+            elif name == "anchor_pos":
+                unresolved &= p.kind[:k] == KIND_ADD
+            rows = np.nonzero(unresolved & (refs != 0))[0]
+            if rows.size:
+                idx = _cross_index()
+                for i in rows:
+                    hit = idx.get(int(refs[i]))
+                    h[i] = hit if hit is not None else -1
+            getattr(out, name)[base:base + k] = h
+        base += k
+
+    out.ts_rank = compute_ts_rank(out.kind, out.ts)
+    out.hints_vouched = all(p.hints_vouched for p in parts)
+    return out
+
+
 def pack_json(payload, max_depth: int = DEFAULT_MAX_DEPTH,
               capacity: Optional[int] = None) -> PackedOps:
     """Wire JSON (str/bytes) → :class:`PackedOps`, using the native parser

@@ -531,19 +531,13 @@ class TpuTree:
         if idx.size == n:
             self._log.extend_packed(pnew)
             self._last_operation = PackedBatch(pnew)
-            # candidate packing == new log packing: reuse the view;
-            # mirror slots are reassigned — outstanding views go stale
-            self._table, self._packed = table, p
-            self._mirror = None
-            self._generation += 1
+            self._commit_view(True, p, table)
         elif idx.size:
-            # absorbed ops sit in the candidate arrays but not in the
-            # log: keep only the applied rows (columnar) and
-            # re-materialise the view from the log on next read
+            # keep only the applied rows (columnar)
             sel = packed_mod.select_rows(pnew, idx)
             self._log.extend_packed(sel)
             self._last_operation = PackedBatch(sel)
-            self._invalidate()
+            self._commit_view(False, p, table)
         else:
             # everything absorbed: log and view unchanged
             self._last_operation = Batch(())
@@ -581,25 +575,27 @@ class TpuTree:
         self._log.extend(applied)
 
     def _commit(self, applied: List[Operation], all_applied: bool,
-                p: PackedOps, table: NodeTable,
-                record: bool = True) -> None:
-        if record:
-            self._record(applied)
-        else:
-            self._log.extend(applied)   # clocks pre-recorded vectorized
+                p: PackedOps, table: NodeTable) -> None:
+        self._record(applied)
         if applied:
-            if all_applied:
-                # candidate packing == new log packing: reuse the view;
-                # mirror slots are reassigned — outstanding views go stale
-                self._table, self._packed = table, p
-                self._mirror = None
-                self._generation += 1
-            else:
-                # absorbed ops sit in the candidate arrays but not in the
-                # log, so value_ref indices would skew — re-materialise from
-                # the log on next read
-                self._invalidate()
+            self._commit_view(all_applied, p, table)
         # else: view unchanged
+
+    def _commit_view(self, all_applied: bool, p: PackedOps,
+                     table: NodeTable) -> None:
+        """View bookkeeping shared by the object (:meth:`_commit`) and
+        columnar (:meth:`apply_packed`) kernel commits: a fully-applied
+        batch's candidate packing == the new log packing, so the view is
+        reused (mirror slots are reassigned — outstanding views go
+        stale); a partial apply leaves absorbed ops in the candidate
+        arrays but not the log, so value_ref indices would skew —
+        re-materialise from the log on next read."""
+        if all_applied:
+            self._table, self._packed = table, p
+            self._mirror = None
+            self._generation += 1
+        else:
+            self._invalidate()
 
     # -- local edits (parity: CRDTree.elm:142-232) ------------------------
 

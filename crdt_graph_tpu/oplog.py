@@ -202,7 +202,10 @@ class OpLog:
 
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return self.materialize(*i.indices(self._len)[:2])
+            start, stop, step = i.indices(self._len)
+            if step != 1:
+                raise ValueError("OpLog slices support step 1 only")
+            return self.materialize(start, stop)
         if i < 0:
             i += self._len
         if not 0 <= i < self._len:
@@ -244,8 +247,9 @@ class OpLog:
                   ) -> PackedOps:
         """The whole log as one PackedOps — object runs pack (per-op,
         but only over interactive-scale runs), column segments slice,
-        and ``packed.concat`` unions pairwise (cross-resolving link
-        hints, so the result stays vouched when every piece is)."""
+        and ``packed.concat_many`` unions everything in ONE allocation
+        (cross-resolving link hints, so the result stays vouched when
+        every piece is)."""
         parts: List[PackedOps] = []
         for seg in self._segs:
             if isinstance(seg, list):
@@ -257,7 +261,4 @@ class OpLog:
                     seg.packed, np.arange(seg.start, seg.stop)))
         if not parts:
             return packed_mod.pack([], max_depth=max_depth)
-        out = parts[0]
-        for p in parts[1:]:
-            out = packed_mod.concat(out, p)
-        return out
+        return packed_mod.concat_many(parts)

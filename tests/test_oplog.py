@@ -24,9 +24,11 @@ def ts(r, c):
 
 
 def chain_ops(r, n, start=1):
-    """n adds by replica r, each anchored on the previous."""
+    """n adds by replica r, each anchored on the previous; a start > 1
+    continues the chain (anchoring on ts(r, start-1)), so split chains
+    carry cross-batch references."""
     out = []
-    prev = 0
+    prev = ts(r, start - 1) if start > 1 else 0
     for c in range(start, start + n):
         out.append(Add(ts(r, c), (prev,), f"v{r}.{c}"))
         prev = ts(r, c)
@@ -88,6 +90,29 @@ def test_to_packed_matches_full_pack():
     assert packed_mod.unpack(a) == packed_mod.unpack(b)
     assert a.hints_vouched
     assert packed_mod.verify_hints(a)
+
+
+def test_slice_step_rejected():
+    log = OpLog(chain_ops(1, 6))
+    with pytest.raises(ValueError):
+        log[::2]
+    with pytest.raises(ValueError):
+        log[::-1]
+
+
+def test_concat_many_matches_pairwise_fold():
+    parts = [packed_mod.pack(chain_ops(1, 5), max_depth=4),
+             packed_mod.pack(chain_ops(2, 3), max_depth=4),
+             # cross-part refs: replica 1's chain continues in part 3
+             packed_mod.pack(chain_ops(1, 4, start=6), max_depth=4)]
+    many = packed_mod.concat_many(parts)
+    fold = packed_mod.concat(packed_mod.concat(parts[0], parts[1]),
+                             parts[2])
+    assert many.num_ops == fold.num_ops == 12
+    assert packed_mod.unpack(many) == packed_mod.unpack(fold)
+    assert many.hints_vouched
+    assert packed_mod.verify_hints(many)
+    np.testing.assert_array_equal(many.ts_rank[:12], fold.ts_rank[:12])
 
 
 def test_packed_batch_is_lazy_and_counts():
