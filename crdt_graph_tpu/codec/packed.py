@@ -456,7 +456,8 @@ def select_rows(p: PackedOps, idx: np.ndarray) -> PackedOps:
 
 
 def concat(a: PackedOps, b: PackedOps) -> PackedOps:
-    """Concatenate two packed batches (the semilattice union before a merge).
+    """Concatenate two packed batches (the semilattice union before a
+    merge) — the two-part case of :func:`concat_many`.
 
     ``a``'s rows precede ``b``'s, and the kernel's stable timestamp sort
     makes the EARLIEST ARRAY ROW the canonical copy of a duplicate — so
@@ -471,77 +472,7 @@ def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     plus index rebuild was ~2.5 s of the warm serving path).  Callers
     treat PackedOps as immutable either way.
     """
-    if a.num_ops == 0:
-        return b
-    if b.num_ops == 0:
-        return a
-    n = a.num_ops + b.num_ops
-    cap = _bucket(n)
-    width = max(a.max_depth, b.max_depth)
-    out = PackedOps(
-        kind=np.full(cap, KIND_PAD, dtype=np.int8),
-        ts=np.zeros(cap, dtype=np.int64),
-        parent_ts=np.zeros(cap, dtype=np.int64),
-        anchor_ts=np.zeros(cap, dtype=np.int64),
-        depth=np.zeros(cap, dtype=np.int32),
-        paths=np.zeros((cap, width), dtype=np.int64),
-        value_ref=np.full(cap, -1, dtype=np.int32),
-        pos=np.arange(cap, dtype=np.int32),
-        values=list(a.values) + list(b.values),
-        num_ops=n)
-    na, nb = a.num_ops, b.num_ops
-    for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth"):
-        getattr(out, name)[:na] = getattr(a, name)[:na]
-        getattr(out, name)[na:n] = getattr(b, name)[:nb]
-    out.paths[:na, :a.max_depth] = a.paths[:na]
-    out.paths[na:n, :b.max_depth] = b.paths[:nb]
-    out.value_ref[:na] = a.value_ref[:na]
-    shifted = b.value_ref[:nb].copy()
-    shifted[shifted >= 0] += len(a.values)
-    out.value_ref[na:n] = shifted
-
-    # Link hints: each side keeps its internal resolutions (b's shifted by
-    # na) and re-resolves its UNRESOLVED refs through the other side's
-    # cached ts index, so hint coverage stays exhaustive for the union —
-    # the kernel's hinted path relies on "every in-batch reference has a
-    # hint" (ops/merge.py step 4).  Typical anti-entropy (old log + new
-    # delta) leaves a's unresolved set empty, so the extra pass is O(new
-    # cross-references), not O(log) — and the other side's index is only
-    # BUILT when some ref actually needs it (a fully-internal 1M batch
-    # paid ~0.8 s of dict construction here for zero lookups).
-    def _fill(side, other, base, other_base, count):
-        other_index = None
-        for name, ref_col in (("parent_pos", "parent_ts"),
-                              ("anchor_pos", "anchor_ts"),
-                              ("target_pos", "ts")):
-            h = getattr(side, name)[:count].copy()
-            refs = getattr(side, ref_col)[:count]
-            unresolved = h < 0
-            h[~unresolved] += base
-            if name == "target_pos":
-                unresolved &= side.kind[:count] == KIND_DELETE
-            elif name == "anchor_pos":
-                unresolved &= side.kind[:count] == KIND_ADD
-            rows = np.nonzero(unresolved & (refs != 0))[0]
-            if rows.size:
-                if other_index is None:
-                    other_index = other.index()
-                for i in rows:
-                    hit = other_index.get(int(refs[i]))
-                    h[i] = hit + other_base if hit is not None else -1
-            getattr(out, name)[base:base + count] = h
-
-    _fill(a, b, 0, na, na)
-    _fill(b, a, na, 0, nb)
-    # merged ts index stays lazy (PackedOps.index builds it vectorized
-    # on first use) — eagerly merging two million-entry dicts was the
-    # single largest cost of the warm bootstrap ingest
-    # rank hints cover the union (post_init saw only padding rows); the
-    # cross-fill above preserves link-hint completeness only if both
-    # sides had it
-    out.ts_rank = compute_ts_rank(out.kind, out.ts)
-    out.hints_vouched = a.hints_vouched and b.hints_vouched
-    return out
+    return concat_many([a, b])
 
 
 def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
@@ -551,13 +482,20 @@ def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
     copies for s segments).
 
     Row order is part order (first-arrival dedup matches sequential
-    application, as in :func:`concat`).  Each part keeps its internal
-    link hints (shifted); refs a part could not resolve internally are
-    looked up in a merged cross-part index, built lazily only when some
-    ref actually needs it.  A hint may point at any add row carrying
-    the referenced timestamp — the kernel verifies ``ts[hint] == want``
-    and elects the canonical duplicate itself — so cross-part duplicate
-    timestamps need no special casing."""
+    application).  Each part keeps its internal link hints (shifted);
+    refs a part could not resolve internally are resolved by PROBING
+    the per-part cached ``index()`` dicts in part order — O(refs ×
+    parts) instead of materializing a merged all-timestamps dict, and
+    each part's index is built vectorized once and CACHED ON THE PART,
+    so repeat exports of the same segments (checkpoint + snapshot +
+    re-materialization) pay nothing the second time.  Typical
+    anti-entropy (old log + new delta) leaves the old side's unresolved
+    set empty, so the pass is O(new cross-references), not O(log).  A
+    hint may point at any add row carrying the referenced timestamp —
+    the kernel verifies ``ts[hint] == want`` and elects the canonical
+    duplicate itself — so cross-part duplicate timestamps need no
+    special casing; probing in part order keeps the deterministic
+    first-part-wins choice anyway."""
     parts = [p for p in parts if p.num_ops]
     if not parts:
         return pack([])
@@ -578,21 +516,20 @@ def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
         pos=np.arange(cap, dtype=np.int32),
         values=values, num_ops=n)
 
-    merged_index: Optional[dict] = None
-
-    def _cross_index() -> dict:
-        nonlocal merged_index
-        if merged_index is None:
-            merged_index = {}
-            b = 0
-            for q in parts:
-                for t, i in q.index().items():
-                    merged_index.setdefault(t, i + b)
-                b += q.num_ops
-        return merged_index
-
-    base = 0
+    bases: List[int] = []
+    b = 0
     for p in parts:
+        bases.append(b)
+        b += p.num_ops
+
+    def _lookup(t: int) -> int:
+        for q, qb in zip(parts, bases):
+            hit = q.index().get(t)
+            if hit is not None:
+                return hit + qb
+        return -1
+
+    for p, base in zip(parts, bases):
         k = p.num_ops
         for name in ("kind", "ts", "parent_ts", "anchor_ts", "depth"):
             getattr(out, name)[base:base + k] = getattr(p, name)[:k]
@@ -613,14 +550,9 @@ def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
                 unresolved &= p.kind[:k] == KIND_DELETE
             elif name == "anchor_pos":
                 unresolved &= p.kind[:k] == KIND_ADD
-            rows = np.nonzero(unresolved & (refs != 0))[0]
-            if rows.size:
-                idx = _cross_index()
-                for i in rows:
-                    hit = idx.get(int(refs[i]))
-                    h[i] = hit if hit is not None else -1
+            for i in np.nonzero(unresolved & (refs != 0))[0]:
+                h[i] = _lookup(int(refs[i]))
             getattr(out, name)[base:base + k] = h
-        base += k
 
     out.ts_rank = compute_ts_rank(out.kind, out.ts)
     out.hints_vouched = all(p.hints_vouched for p in parts)

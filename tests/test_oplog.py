@@ -100,19 +100,25 @@ def test_slice_step_rejected():
         log[::-1]
 
 
-def test_concat_many_matches_pairwise_fold():
+def test_concat_many_matches_single_pack():
+    """N-way union (which concat() is now the 2-part case of) must be
+    indistinguishable from packing the flat op list: same rows, hints
+    that VERIFY (cross-part refs resolved — replica 1's chain continues
+    in part 3, referencing part 1), vouch preserved."""
+    flat = chain_ops(1, 5) + chain_ops(2, 3) + chain_ops(1, 4, start=6)
     parts = [packed_mod.pack(chain_ops(1, 5), max_depth=4),
              packed_mod.pack(chain_ops(2, 3), max_depth=4),
-             # cross-part refs: replica 1's chain continues in part 3
              packed_mod.pack(chain_ops(1, 4, start=6), max_depth=4)]
     many = packed_mod.concat_many(parts)
-    fold = packed_mod.concat(packed_mod.concat(parts[0], parts[1]),
-                             parts[2])
-    assert many.num_ops == fold.num_ops == 12
-    assert packed_mod.unpack(many) == packed_mod.unpack(fold)
+    one = packed_mod.pack(flat, max_depth=4)
+    assert many.num_ops == one.num_ops == 12
+    assert packed_mod.unpack(many) == flat
+    # part 3's first op anchors on ts(1,5) — a CROSS-PART ref that must
+    # carry a verified hint for the union to stay exhaustive
+    assert many.anchor_pos[8] == 4
     assert many.hints_vouched
     assert packed_mod.verify_hints(many)
-    np.testing.assert_array_equal(many.ts_rank[:12], fold.ts_rank[:12])
+    np.testing.assert_array_equal(many.ts_rank[:12], one.ts_rank[:12])
 
 
 def test_packed_batch_is_lazy_and_counts():
