@@ -346,11 +346,23 @@ def _res_hint_impl(hint, want, op_slot_arr, is_add, ts, N, ROOT, NULL):
     return slot.astype(jnp.int32), (want == 0) | ok, miss
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _probe_sum(*arrs):
+    """Stage-cut checksum: a scalar depending on every given array, so
+    honest timing (dispatch + forced readback) cannot skip the stage.
+    Only reachable when ``probe`` is set — never in production traces.
+    Delegates to bench.honest.fingerprint (lazy import; honest has no
+    ops dependency) so int64 leaves split into int32 halves on TPU —
+    a wide emulated modulo would bill the HARNESS to the stage."""
+    from ..bench.honest import fingerprint
+    return fingerprint(arrs)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _materialize(ops: Dict[str, jax.Array],
                  use_pallas: Optional[bool] = None,
                  hints: Optional[str] = None,
-                 no_deletes: bool = False) -> NodeTable:
+                 no_deletes: bool = False,
+                 probe: Optional[int] = None) -> NodeTable:
     """``use_pallas``: pallas usage for the rank-expansion gathers
     (ops/mono_gather.py).  None = auto (Mosaic kernel on TPU backends,
     lax elsewhere); wrappers whose transforms the pallas call must not
@@ -372,7 +384,20 @@ def _materialize(ops: Dict[str, jax.Array],
     machinery (steps 7-8) and the delete statuses at trace time — the
     common all-adds serving batch compiles and runs leaner.  A violated
     promise would silently ignore deletes, so only host-checked callers
-    set it."""
+    set it.
+
+    ``probe``: profiling cut point (scripts/probe_stages.py).  When set
+    to stage k, the trace TRUNCATES right after that stage and returns a
+    CUMULATIVE checksum folding every stage ≤ k — cumulative so the
+    cuts nest strictly (a per-stage-only checksum would let XLA
+    dead-code-eliminate earlier stages nothing downstream consumes, and
+    consecutive differences would misattribute); per-stage device time
+    is then genuinely the difference between consecutive cuts, measured
+    on the exact production trace (the old standalone probe mirrored
+    the kernel and drifted).  Cuts: 1 resolution | 2 frames+local
+    validity | 3 cascade+cycles | 4 deletes+dead | 5 NSA+sibling
+    sort+tour | 6 run contraction+Wyllie+expansion | 7 ranks+orders |
+    None full kernel."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -536,11 +561,16 @@ def _materialize(ops: Dict[str, jax.Array],
     else:
         sel = _sorted_ops(None)
 
-    return _finish(ops, sel, use_pallas, no_deletes)
+    acc = _probe_sum(*sel) if probe is not None else None
+    if probe == 1:
+        return acc
+    return _finish(ops, sel, use_pallas, no_deletes, probe=probe,
+                   acc=acc)
 
 
 def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
-            no_deletes: bool) -> NodeTable:
+            no_deletes: bool, probe: Optional[int] = None,
+            acc=None) -> NodeTable:
     """Stages 3-13: node-table construction through per-op statuses,
     from the resolution interface (the 11-tuple ``sel``).  Extracted
     from ``_materialize`` so the explicitly partitioned resolve
@@ -610,6 +640,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
     local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
     local_ok = local_ok.at[ROOT].set(True)
+    if probe is not None:
+        acc = acc + _probe_sum(local_ok, parent_ok, fp)
+        if probe == 2:
+            return acc
 
     # ---- 6. Validity cascades along the anchor forest: a node exists only
     # if its anchor chain and tree ancestors all exist.  Parked slots are
@@ -655,6 +689,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     valid = valid.at[ROOT].set(True)
     # canonical parent pointer for existing nodes; root for itself
     parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
+    if probe is not None:
+        acc = acc + _probe_sum(valid, parent_eff)
+        if probe == 3:
+            return acc
 
     # ---- 7. Deletes: tombstone valid targets (first delete per target wins
     # the log; the tree flag is an idempotent OR either way).  Target match
@@ -687,6 +725,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
                            _ceil_log2(D) + 1)
         dead = valid & (anc_del < IPOS)
+    if probe is not None:
+        acc = acc + _probe_sum(deleted, dead, anc_del)
+        if probe == 4:
+            return acc
 
     # ---- 9. The order forest: each node's T* parent is the nearest node on
     # its within-branch anchor chain with a SMALLER timestamp (-1 = chain
@@ -840,6 +882,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         ~in_tour, M + slot_ids,
         jnp.where(sib_next >= 0, sib_next, up))
     succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
+    if probe is not None:
+        acc = acc + _probe_sum(succ, sib_next, first_child)
+        if probe == 5:
+            return acc
 
     # ---- 11. Masks (the ranking below counts them as token weights).
     exists = valid & is_node_slot
@@ -949,6 +995,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             n_runs <= R_CAP,
             lambda _: _expand(run_s[:R_CAP], run_e[:R_CAP]),
             lambda _: _expand(run_s, run_e), None)
+    if probe is not None:
+        acc = acc + _probe_sum(ex)
+        if probe == 6:
+            return acc
 
     # E(tok) = weight at-or-after tok along the chain; within-run
     # offsets from the global cumsum (forward runs count from the run
@@ -972,6 +1022,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     visible_order = jnp.full(M, NULL, jnp.int32).at[
         jnp.where(visible, vis_dense, M)].set(
             slot_ids, mode="drop", unique_indices=True)
+    if probe is not None:
+        acc = acc + _probe_sum(doc_index, order, visible_order)
+        if probe == 7:
+            return acc
 
     # ---- 13. Sequential-parity statuses per op.  Per-slot facts pack
     # into one int32 so each op needs two gathers (meta + anc_del), not

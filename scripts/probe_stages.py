@@ -1,29 +1,30 @@
-"""Prefix-staged honest timing of the merge kernel on the current device.
+"""Per-stage honest timing of the merge kernel on the current device.
 
-Times the kernel truncated after each stage; consecutive differences
-apportion device time per stage (each prefix is its own jit compile).
-MIRRORS ops/merge.py's ranked+hinted path (r3 kernel) — keep the cut
-points in sync when the kernel changes.
+Times the PRODUCTION trace truncated after each stage via the kernel's
+own static ``probe`` cut points (ops/merge.py ``_materialize``/
+``_finish``) — consecutive differences apportion device time per stage.
+The cuts live inside the kernel, so this can never drift from it (the
+previous standalone mirror did, and over-reported the tour stage by the
+cost of combiner scatters the kernel no longer uses).
 
-Stages:
- 1  ranked slot assignment + scatters + link-hint resolution (steps 1-4)
- 2  + materialised paths + local validity (step 5)
- 3  + validity cascade / cycles (step 6)
- 4  + deletes + dead propagation (steps 7-8)
- 5  + NSA chase + sibling sort + tour successors (steps 9-10)
- 6  + run contraction + Wyllie (step 12 first half)
- 7  + rank expansion + orders (step 12 second half)
- 8  full kernel incl. statuses (= merge._materialize)
+Stages: 1 resolution | 2 frames+local validity | 3 cascade+cycles |
+4 deletes+dead | 5 NSA+sibling sort+tour | 6 runs+Wyllie+expansion |
+7 ranks+orders | 8 full kernel incl. statuses.
 
-Usage: python scripts/probe_stages.py [N] [stage...]
+Runs the bench's production configuration: hints="exhaustive",
+host-checked no_deletes, chain workload.  Emits one JSON line at the
+end for the sweep artifact.
+
+Usage: python scripts/probe_stages.py [N] [stage...]   (device = whatever
+JAX selects; pin CPU by scrubbing the env first, see tests/conftest.py)
 """
+import functools
+import json
 import sys
 
 sys.path.insert(0, "/root/repo")
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 
 from crdt_graph_tpu.utils import compcache
 compcache.enable()
@@ -31,339 +32,45 @@ jax.config.update("jax_enable_x64", True)
 
 from crdt_graph_tpu.bench import honest
 from crdt_graph_tpu.bench.workloads import chain_workload
-from crdt_graph_tpu.codec.packed import KIND_ADD, KIND_DELETE
 from crdt_graph_tpu.ops import merge as merge_mod
-from crdt_graph_tpu.ops import mono_gather
-from crdt_graph_tpu.ops.merge import (_ceil_log2, _fix_and, _fix_min,
-                                      IPOS, BIG)
-
-
-def checksum(*arrs):
-    s = jnp.int64(0)
-    for a in arrs:
-        if a.dtype == jnp.bool_:
-            a = a.astype(jnp.int32)
-        s = s + jnp.sum(a.astype(jnp.int64) % 1000003)
-    return s
-
-
-def staged(ops, stage):
-    """ops/merge.py's ranked+hinted path, truncated after ``stage``."""
-    kind = ops["kind"]
-    ts = ops["ts"].astype(jnp.int64)
-    parent_ts = ops["parent_ts"].astype(jnp.int64)
-    anchor_ts = ops["anchor_ts"].astype(jnp.int64)
-    depth = ops["depth"].astype(jnp.int32)
-    paths = ops["paths"].astype(jnp.int64)
-    value_ref = ops["value_ref"].astype(jnp.int32)
-    pos = ops["pos"].astype(jnp.int32)
-
-    N = kind.shape[0]
-    D = paths.shape[1]
-    M = N + 2
-    ROOT = 0
-    NULL = M - 1
-    slot_ids = jnp.arange(M, dtype=jnp.int32)
-    is_add = kind == KIND_ADD
-    is_del = kind == KIND_DELETE
-    cols = jnp.arange(D, dtype=jnp.int32)[None, :]
-
-    # ---- steps 1-4, ranked branch (trust hints like "exhaustive" so the
-    # probe profiles the path real merges execute)
-    rank = ops["ts_rank"].astype(jnp.int32)
-    is_real_add = is_add & (ts > 0) & (ts < BIG)
-    has_rank = is_real_add & (rank >= 0) & (rank < N)
-    op_slot = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
-    win = jnp.full(M, IPOS, jnp.int32).at[
-        jnp.where(has_rank, op_slot, M)].min(pos, mode="drop")
-    is_canon_op = has_rank & (pos == win[op_slot])
-    op_is_dup = has_rank & ~is_canon_op
-    tgt_op = jnp.where(is_canon_op, op_slot, M)
-
-    def scat_op(init, vals):
-        return init.at[tgt_op].set(vals, mode="drop", unique_indices=True)
-
-    node_ts = scat_op(jnp.full(M, BIG, jnp.int64), ts) \
-        .at[ROOT].set(0).at[NULL].set(BIG)
-    node_depth = scat_op(jnp.zeros(M, jnp.int32), depth).at[ROOT].set(0)
-    node_value_ref = scat_op(jnp.full(M, -1, jnp.int32), value_ref)
-    node_pos = win
-    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt_op].set(
-        paths, mode="drop", unique_indices=True)
-    is_node_slot = scat_op(jnp.zeros(M, bool), jnp.ones(N, bool))
-    node_anchor_is_sentinel = scat_op(jnp.zeros(M, bool), anchor_ts == 0)
-
-    def _res(hint, want):
-        p = jnp.clip(hint, 0, N - 1)
-        ok = (hint >= 0) & is_add[p] & (ts[p] == want) & \
-            (want > 0) & (want < BIG)
-        slot = jnp.where(want == 0, ROOT, jnp.where(ok, op_slot[p], NULL))
-        return slot.astype(jnp.int32), (want == 0) | ok
-
-    pp_slot, pp_found = _res(ops["parent_pos"].astype(jnp.int32), parent_ts)
-    aa_slot, aa_found = _res(ops["anchor_pos"].astype(jnp.int32), anchor_ts)
-    d_tslot, d_tfound = _res(ops["target_pos"].astype(jnp.int32), ts)
-    dp_slot, dp_found = pp_slot, pp_found
-    pslot = scat_op(jnp.full(M, NULL, jnp.int32), pp_slot)
-    aslot = scat_op(jnp.full(M, NULL, jnp.int32), aa_slot)
-    pfound = scat_op(jnp.zeros(M, bool), pp_found)
-    afound = scat_op(jnp.zeros(M, bool), aa_found)
-    pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
-    if stage == 1:
-        return checksum(op_slot, op_is_dup, node_ts, pslot, aslot)
-
-    col = jnp.clip(node_depth - 1, 0, D - 1)
-    fp = node_claimed.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
-        unique_indices=True)
-    prefix_ok = jnp.all(
-        jnp.where(cols < node_depth[:, None] - 1,
-                  node_claimed == fp[pslot], True), axis=1)
-    depth_ok = (node_depth >= 1) & (node_depth <= D) & \
-        (node_depth == node_depth[pslot] + 1)
-    parent_ok = pfound & depth_ok & prefix_ok
-    anchor_ok = node_anchor_is_sentinel | \
-        (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
-    local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
-    local_ok = local_ok.at[ROOT].set(True)
-    if stage == 2:
-        return checksum(local_ok, parent_ok, fp)
-
-    order_parent = jnp.where(node_anchor_is_sentinel, pslot, aslot)
-    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
-    cascade_ok = _fix_and(local_ok | ~is_node_slot, order_parent,
-                          _ceil_log2(M) + 1)
-    up_edge = jnp.any(is_node_slot & ~node_anchor_is_sentinel &
-                      (aslot != NULL) & (aslot >= slot_ids))
-
-    def _reaches_terminal(ptr):
-        k_cap = _ceil_log2(M) + 1
-
-        def body(state):
-            p, i = state
-            return p[p], i + 1
-
-        p, _ = lax.while_loop(lambda s: s[1] < k_cap, body,
-                              (ptr, jnp.int32(0)))
-        return (p == ROOT) | (p == NULL)
-
-    acyclic = lax.cond(up_edge, _reaches_terminal,
-                       lambda p: jnp.ones(M, bool), order_parent)
-    valid = cascade_ok & acyclic & is_node_slot
-    valid = valid.at[ROOT].set(True)
-    parent_eff = jnp.where(valid, pslot, NULL).at[ROOT].set(ROOT)
-    if stage == 3:
-        return checksum(valid, parent_eff)
-
-    d_depth_ok = (depth >= 1) & (depth <= D) & (node_depth[d_tslot] == depth)
-    d_path_ok = jnp.all(
-        jnp.where(cols < depth[:, None], paths == fp[d_tslot], True), axis=1)
-    d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
-        d_depth_ok & d_path_ok
-    d_tgt = jnp.where(d_ok, d_tslot, NULL)
-    deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
-    del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
-        .at[NULL].set(IPOS)
-    anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
-    anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
-                       _ceil_log2(D) + 1)
-    dead = valid & (anc_del < IPOS)
-    if stage == 4:
-        return checksum(deleted, dead, anc_del)
-
-    in_forest = valid & is_node_slot
-    mptr0 = jnp.where(node_anchor_is_sentinel | ~in_forest, -1, aslot)
-    nsv_cap = _ceil_log2(M) + 2
-
-    def nsv_cond(state):
-        mptr, i = state
-        return (i < nsv_cap) & jnp.any((mptr >= 0) & (mptr > slot_ids))
-
-    def nsv_body(state):
-        mptr, i = state
-        m = jnp.where(mptr >= 0, mptr, NULL)
-        unresolved = (mptr >= 0) & (mptr > slot_ids)
-        return jnp.where(unresolved, mptr[m], mptr), i + 1
-
-    mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
-    star_parent = jnp.where(mptr >= 0, mptr, pslot)
-    star_sentinel = mptr < 0
-
-    order_parent = jnp.where(in_forest, star_parent, order_parent)
-    order_parent = order_parent.at[ROOT].set(ROOT).at[NULL].set(NULL)
-    ggrp = jnp.where(star_sentinel, 0, 1).astype(jnp.int8)
-
-    def _sib_links(kp, gg, neg):
-        s_parent, _, s_neg = lax.sort((kp, gg, neg), num_keys=3)
-        s_slot = jnp.where(s_neg == IPOS, M, -s_neg)
-        same_parent = (s_parent[1:] == s_parent[:-1]) & (s_slot[1:] < M)
-        sib = jnp.full(M, -1, jnp.int32).at[s_slot[:-1]].set(
-            jnp.where(same_parent, s_slot[1:], -1),
-            mode="drop", unique_indices=True)
-        s_start = jnp.concatenate([jnp.ones(1, bool), ~same_parent])
-        fc_tgt = jnp.where(s_start & (s_slot < M), s_parent, M)
-        fc = jnp.full(M, -1, jnp.int32).at[fc_tgt].set(
-            s_slot, mode="drop", unique_indices=True)
-        return sib, fc
-
-    skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
-    neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
-    S_CAP = 1 << 16
-    if S_CAP >= M:
-        sib_next, first_child = _sib_links(skey, ggrp, neg_slot)
-    else:
-        par = jnp.where(in_forest, order_parent, M)
-        cnt = jnp.zeros(M, jnp.int32).at[par].add(1, mode="drop")
-        crowded = in_forest & (cnt[jnp.minimum(par, M - 1)] >= 2)
-        cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
-        n_crowded = cpos[M - 1] + 1
-
-        def br_small(_):
-            at = jnp.where(crowded, cpos, S_CAP)
-            kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                skey, mode="drop", unique_indices=True)
-            gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
-                ggrp, mode="drop", unique_indices=True)
-            neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                neg_slot, mode="drop", unique_indices=True)
-            sib, fc = _sib_links(kp, gg, neg)
-            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
-            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
-                       ].set(jnp.where(single_v < M, single_v, -1),
-                             mode="drop", unique_indices=True)
-            return sib, fc
-
-        sib_next, first_child = lax.cond(
-            n_crowded <= S_CAP, br_small,
-            lambda _: _sib_links(skey, ggrp, neg_slot), None)
-    sib_next = sib_next.at[ROOT].set(-1)
-    first_child = first_child.at[NULL].set(-1)
-
-    T = 2 * M
-    tok = jnp.arange(T, dtype=jnp.int32)
-    in_tour = in_forest.at[ROOT].set(True)
-    enter_succ = jnp.where(
-        ~in_tour, slot_ids,
-        jnp.where(first_child >= 0, first_child, M + slot_ids))
-    up = jnp.where(order_parent == slot_ids, M + slot_ids, M + order_parent)
-    exit_succ = jnp.where(
-        ~in_tour, M + slot_ids,
-        jnp.where(sib_next >= 0, sib_next, up))
-    succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
-    if stage == 5:
-        return checksum(succ, sib_next, first_child)
-
-    exists = valid & is_node_slot
-    tomb = deleted & exists
-    dead = dead & exists
-    visible = exists & ~tomb & ~dead
-
-    fwd = succ[:-1] == tok[1:]
-    bwd = succ[1:] == tok[:-1]
-    same_run = fwd | bwd
-    boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
-    rid = lax.cumsum(boundary.astype(jnp.int32)) - 1
-    run_s = jnp.full(T, IPOS, jnp.int32).at[rid].min(
-        tok, indices_are_sorted=True)
-    run_e = jnp.zeros(T, jnp.int32).at[rid].max(
-        tok, indices_are_sorted=True)
-    run_fwd = succ[run_s] == run_s + 1
-    run_tail = jnp.where(run_fwd, run_e, run_s)
-    tail_succ = succ[run_tail]
-    run_terminal = tail_succ == run_tail
-    run_next = jnp.where(run_terminal, rid[run_tail], rid[tail_succ])
-
-    cse_doc = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
-    cse_vis = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), lax.cumsum(visible.astype(jnp.int32))])
-    run_s_c = jnp.minimum(run_s, M)
-    run_e1_c = jnp.minimum(run_e + 1, M)
-
-    def run_sum(cse):
-        return jnp.where(run_terminal, 0, cse[run_e1_c] - cse[run_s_c])
-
-    def _wyllie(a, b, p, cap):
-        def wy_cond(state):
-            _, _, _, live, i = state
-            return live & (i < cap)
-
-        def wy_body(state):
-            a, b, p, _, i = state
-            return a + a[p], b + b[p], p[p], jnp.any(p[p] != p), i + 1
-
-        a, b, _, _, _ = lax.while_loop(
-            wy_cond, wy_body, (a, b, p, jnp.array(True), jnp.int32(0)))
-        return a, b
-
-    a0, b0 = run_sum(cse_doc), run_sum(cse_vis)
-    R_CAP = 1 << 15
-    if R_CAP >= T:
-        a_doc, a_vis = _wyllie(a0, b0, run_next, _ceil_log2(T) + 1)
-    else:
-        n_runs = rid[T - 1] + 1
-
-        def br_small(args):
-            a, b, p = args
-            a_s, b_s = _wyllie(a[:R_CAP], b[:R_CAP],
-                               jnp.minimum(p[:R_CAP], R_CAP - 1),
-                               _ceil_log2(R_CAP) + 1)
-            pad = jnp.zeros(T - R_CAP, jnp.int32)
-            return (jnp.concatenate([a_s, pad]),
-                    jnp.concatenate([b_s, pad]))
-
-        def br_full(args):
-            a, b, p = args
-            return _wyllie(a, b, p, _ceil_log2(T) + 1)
-
-        a_doc, a_vis = lax.cond(n_runs <= R_CAP, br_small, br_full,
-                                (a0, b0, run_next))
-    if stage == 6:
-        return checksum(a_doc, a_vis, rid)
-
-    per_run = jnp.stack([
-        run_fwd[:M].astype(jnp.int32),
-        cse_doc[run_s_c[:M]], cse_doc[run_e1_c[:M]], a_doc[:M],
-        cse_vis[run_s_c[:M]], cse_vis[run_e1_c[:M]], a_vis[:M],
-    ])
-    ex = mono_gather.monotone_gather(per_run, rid[:M])
-    rf_m = ex[0].astype(bool)
-
-    def rank_of(ws_m, we1_m, a_m, cse):
-        within = jnp.where(rf_m, cse[:M] - ws_m, we1_m - cse[1:M + 1])
-        e_tok = a_m - within
-        return e_tok[ROOT] - e_tok
-
-    doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
-    vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
-    doc_index = jnp.where(exists, doc_dense, IPOS)
-    order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(exists, doc_dense, M)].set(
-            slot_ids, mode="drop", unique_indices=True)
-    visible_order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(visible, vis_dense, M)].set(
-            slot_ids, mode="drop", unique_indices=True)
-    if stage == 7:
-        return checksum(doc_index, order, visible_order)
-
-    t = merge_mod._materialize(ops)
-    return checksum(t.doc_index, t.order, t.visible_order, t.status,
-                    t.num_visible)
 
 
 def main():
     args = [int(a) for a in sys.argv[1:]]
     n = args[0] if args else 1_000_000
     stages = args[1:] or list(range(1, 9))
-    ops = jax.device_put(chain_workload(64, n))
+    host_ops = chain_workload(64, n)
+    no_deletes = merge_mod.host_no_deletes(host_ops["kind"])
+    ops = jax.device_put(host_ops)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(o, stage):
+        if stage == 8:
+            # the FULL NodeTable (not the narrower headline
+            # fingerprint): stage 8 must be a strict superset of cut 7
+            # or the order scatters DCE and delta(8) goes negative
+            t = merge_mod._materialize(o, hints="exhaustive",
+                                       no_deletes=no_deletes)
+            return honest.fingerprint(t)
+        return merge_mod._materialize(o, hints="exhaustive",
+                                      no_deletes=no_deletes, probe=stage)
+
     prev = 0.0
+    rows = []
+    dev = jax.devices()[0]
     for st in stages:
-        fn = jax.jit(staged, static_argnums=1)
-        s = honest.time_with_readback(fn, ops, st, repeats=3)
+        s = honest.time_with_readback(run, ops, st, repeats=3)
         p50 = s["p50_ms"]
         print(f"stage {st}: p50 {p50:9.1f} ms   delta {p50 - prev:9.1f} ms"
               f"   (compile+warm {s['warm_ms']/1e3:.1f}s)", flush=True)
+        rows.append({"stage": st, "p50_ms": round(p50, 1),
+                     "delta_ms": round(p50 - prev, 1)})
         prev = p50
+    print(json.dumps({"metric": "merge_stage_profile", "n_ops": n,
+                      "device": dev.platform,
+                      "device_kind": dev.device_kind,
+                      "mode": "exhaustive+no_deletes",
+                      "stages": rows}), flush=True)
 
 
 if __name__ == "__main__":

@@ -460,6 +460,24 @@ def test_no_deletes_trace_parity():
     assert not merge.host_no_deletes(p2.arrays()["kind"])
 
 
+def test_probe_cuts_run_every_stage():
+    """The kernel's profiling cut points (merge._materialize probe=k,
+    scripts/probe_stages.py) must keep returning a scalar at every
+    stage, with and without deletes — so the on-chip stage profile the
+    r4 verdict asked for can never bit-rot."""
+    import jax
+    _, ops = _random_session(23, n_replicas=3, steps=40)
+    for op_set in (ops, [op for op in ops if not isinstance(op, Delete)]):
+        arrs = packed.pack(op_set).arrays()
+        nd = merge.host_no_deletes(arrs["kind"])
+        with jax.enable_x64(True):
+            for k in range(1, 8):
+                out = merge._materialize(arrs, None, "exhaustive", nd, k)
+                assert np.asarray(out).shape == (), k
+            t = merge._materialize(arrs, None, "exhaustive", nd, None)
+        assert hasattr(t, "status")
+
+
 def test_hostile_pos_duplicate_winner_agrees():
     """ADVICE r3: a raw-array producer violating the pos == array-index
     contract must not let the ranked path and the join fallback pick
