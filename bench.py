@@ -137,6 +137,11 @@ def _precheck() -> None:
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # mirror _child: env alone is not enough, the axon sitecustomize
+        # can re-register the TPU plugin — without this a CPU-pinned
+        # precheck hangs on the tunnel AS A SECOND CLIENT
+        jax.config.update("jax_platforms", "cpu")
     x = jax.device_put(np.arange(8, dtype=np.int32))
     val = int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v + 1))(x))))
     assert val == 36
@@ -177,6 +182,13 @@ def _run_child(env: dict, timeout_s: int) -> int:
 def main() -> None:
     _warn_siblings()
     env = dict(os.environ)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        # caller pinned CPU: no tunnel to probe, run the measurement
+        # directly (used by smoke tests; the driver leaves this unset).
+        # Scrub the plugin env too, mirroring the CPU fallback below —
+        # a registered axon plugin would dial the tunnel from the child
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        sys.exit(_run_child(env, CPU_TIMEOUT_S))
     alive = _tunnel_alive(env)
     if not alive:
         print("bench: retrying tunnel precheck once after 60s",
