@@ -77,7 +77,43 @@ def check(seed):
     p2 = packed.pack(perm, max_depth=md)
     t2 = view.to_host(merge.materialize(p2.arrays()))
     assert view.visible_values(t2, p2.values) == want, (seed, "perm+dup")
+
+    # columnar engine path (round 5): the same causal log ingested
+    # through TpuTree.apply_packed in random chunk splits — log stays
+    # column segments, duplicates within the redelivered overlap absorb
+    # via select_rows — then a binary checkpoint round trip and an
+    # indexed operations_since suffix, all against the oracle
+    from crdt_graph_tpu import engine
+    eng = engine.init(0, max_depth=md)
+    i = 0
+    while i < len(ops):
+        k = rng.choice([7, 60, 400, len(ops)])
+        chunk = ops[max(0, i - rng.choice([0, 3])):i + k]   # overlap dups
+        eng.apply_packed(packed.pack(chunk, max_depth=md))
+        i += k
+    assert eng.visible_values() == want, (seed, "engine columnar")
+    assert eng.log_length == len(ops), (seed, "engine log len")
+    import io
+    buf = io.BytesIO()
+    eng.checkpoint_packed(buf, compress=False)
+    buf.seek(0)
+    rest = engine.TpuTree.restore_packed(buf)
+    assert rest.visible_values() == want, (seed, "checkpoint roundtrip")
+    if ops:
+        mid = ops[rng.randrange(len(ops))]
+        ts_mid = op_timestamp_of(mid)
+        if ts_mid is not None:
+            from crdt_graph_tpu.core import operation as op_mod
+            suffix = eng.operations_since(ts_mid)
+            oracle_suffix = merged.operations_since(ts_mid)
+            assert op_mod.to_list(suffix) == \
+                op_mod.to_list(oracle_suffix), (seed, "since suffix")
     return len(ops)
+
+
+def op_timestamp_of(op):
+    from crdt_graph_tpu.core import operation as op_mod
+    return op_mod.op_timestamp(op)
 
 
 def main(n):
