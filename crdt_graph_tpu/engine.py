@@ -709,7 +709,7 @@ class TpuTree:
         position of the matching Add — only those rows materialize to
         objects (columnar log, oplog.OpLog)."""
         if initial_timestamp == 0:
-            return op_mod.from_list(tuple(self._log))
+            return self._log.as_batch()
         start = self._log.index_of_add(initial_timestamp)
         if start is None:
             return Batch(())

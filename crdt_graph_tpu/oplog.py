@@ -231,6 +231,17 @@ class OpLog:
             base += len(seg)
         return None
 
+    def as_batch(self) -> Batch:
+        """The whole log as one Batch — lazily (a PackedBatch over the
+        columns) when the log is a single column segment, so a
+        bootstrap-restored document answering ``operations_since(0)``
+        through the OBJECT api doesn't materialize a million ops the
+        caller may never touch; otherwise a plain materialized Batch."""
+        if len(self._segs) == 1 and not isinstance(self._segs[0], list):
+            seg = self._segs[0]
+            return PackedBatch(seg.packed, seg.start, seg.stop)
+        return Batch(tuple(self))
+
     def tail_is(self, pb: PackedBatch) -> bool:
         """True iff ``pb`` wraps exactly this log's final segment rows —
         the O(1) identity check behind the binary checkpoint's

@@ -2,18 +2,22 @@
 
 Times the PRODUCTION trace truncated after each stage via the kernel's
 own static ``probe`` cut points (ops/merge.py ``_materialize``/
-``_finish``) — consecutive differences apportion device time per stage.
-The cuts live inside the kernel, so this can never drift from it (the
-previous standalone mirror did, and over-reported the tour stage by the
-cost of combiner scatters the kernel no longer uses).
+``_finish``) — cumulative/nested, so consecutive differences apportion
+device time per stage and XLA cannot DCE an earlier stage out of a
+later cut.  The cuts live inside the kernel, so this can never drift
+from it (the previous standalone mirror did, and over-reported the tour
+stage by ~2×).  Each cut also pays its own checksum passes, so the
+clean full kernel (stage 8, full-table fingerprint) can time below cut
+7 — documented in docs/SHARD_TAIL.md §1.
 
 Stages: 1 resolution | 2 frames+local validity | 3 cascade+cycles |
 4 deletes+dead | 5 NSA+sibling sort+tour | 6 runs+Wyllie+expansion |
-7 ranks+orders | 8 full kernel incl. statuses.
+7 ranks+orders | 8 full kernel incl. statuses (no cuts).
 
 Runs the bench's production configuration: hints="exhaustive",
-host-checked no_deletes, chain workload.  Emits one JSON line at the
-end for the sweep artifact.
+host-checked no_deletes, chain workload.  ``profile()`` is the single
+driver loop — the TPU session (scripts/tpu_session.py phase 7) imports
+it so the on-chip and CPU profiles cannot diverge.
 
 Usage: python scripts/probe_stages.py [N] [stage...]   (device = whatever
 JAX selects; pin CPU by scrubbing the env first, see tests/conftest.py)
@@ -35,10 +39,12 @@ from crdt_graph_tpu.bench.workloads import chain_workload
 from crdt_graph_tpu.ops import merge as merge_mod
 
 
-def main():
-    args = [int(a) for a in sys.argv[1:]]
-    n = args[0] if args else 1_000_000
-    stages = args[1:] or list(range(1, 9))
+def profile(n: int = 1_000_000, stages=None, repeats: int = 3,
+            log=lambda m: None) -> list:
+    """Stage-cut rows for the production 64-chain merge at ``n`` ops on
+    the current device.  Shared by the CPU driver below and the TPU
+    session's phase 7."""
+    stages = list(stages or range(1, 9))
     host_ops = chain_workload(64, n)
     no_deletes = merge_mod.host_no_deletes(host_ops["kind"])
     ops = jax.device_put(host_ops)
@@ -47,25 +53,32 @@ def main():
     def run(o, stage):
         if stage == 8:
             # the FULL NodeTable (not the narrower headline
-            # fingerprint): stage 8 must be a strict superset of cut 7
-            # or the order scatters DCE and delta(8) goes negative
-            t = merge_mod._materialize(o, hints="exhaustive",
-                                       no_deletes=no_deletes)
+            # fingerprint): stage 8 has no cuts and forces every output
+            t = merge_mod._materialize(o, None, "exhaustive", no_deletes)
             return honest.fingerprint(t)
-        return merge_mod._materialize(o, hints="exhaustive",
-                                      no_deletes=no_deletes, probe=stage)
+        return merge_mod._materialize(o, None, "exhaustive", no_deletes,
+                                      stage)
 
-    prev = 0.0
     rows = []
-    dev = jax.devices()[0]
+    prev = 0.0
     for st in stages:
-        s = honest.time_with_readback(run, ops, st, repeats=3)
-        p50 = s["p50_ms"]
-        print(f"stage {st}: p50 {p50:9.1f} ms   delta {p50 - prev:9.1f} ms"
-              f"   (compile+warm {s['warm_ms']/1e3:.1f}s)", flush=True)
-        rows.append({"stage": st, "p50_ms": round(p50, 1),
-                     "delta_ms": round(p50 - prev, 1)})
-        prev = p50
+        s = honest.time_with_readback(run, ops, st, repeats=repeats)
+        rows.append({"stage": st, "p50_ms": s["p50_ms"],
+                     "delta_ms": round(s["p50_ms"] - prev, 1),
+                     "compile_s": round(s["warm_ms"] / 1e3, 1)})
+        log(f"stage {st}: p50 {s['p50_ms']:9.1f} ms   "
+            f"delta {s['p50_ms'] - prev:9.1f} ms   "
+            f"(compile+warm {s['warm_ms']/1e3:.1f}s)")
+        prev = s["p50_ms"]
+    return rows
+
+
+def main():
+    args = [int(a) for a in sys.argv[1:]]
+    n = args[0] if args else 1_000_000
+    stages = args[1:] or None
+    dev = jax.devices()[0]
+    rows = profile(n, stages, log=lambda m: print(m, flush=True))
     print(json.dumps({"metric": "merge_stage_profile", "n_ops": n,
                       "device": dev.platform,
                       "device_kind": dev.device_kind,

@@ -82,7 +82,13 @@ def check(seed):
     # through TpuTree.apply_packed in random chunk splits — log stays
     # column segments, duplicates within the redelivered overlap absorb
     # via select_rows — then a binary checkpoint round trip and an
-    # indexed operations_since suffix, all against the oracle
+    # indexed operations_since suffix, all against the oracle.
+    # Sampled ~1-in-3 via the session rng (chunked ingest jit-compiles
+    # many bucket shapes; running it every session tripled soak
+    # wall-clock), with the FIRST session always checked so short runs
+    # cannot skip engine coverage entirely
+    if seed != 1000 and rng.random() > 1 / 3:
+        return len(ops)
     from crdt_graph_tpu import engine
     eng = engine.init(0, max_depth=md)
     i = 0

@@ -8,8 +8,15 @@ phase failure records an error line and later phases still run):
 2. headline 1M merge: honest timing + async-gap audit + closed-form
    order check fused into the timed kernel
 3. pallas rank-gather A/B: use_pallas True vs False (static-arg variants)
-4. 8-config sweep with fused full-sequence order checks
-5. scale sweep 250k-2M
+4. 8-config sweep with fused full-sequence order checks (production
+   exhaustive mode, disclosed per row)
+5. scale sweep 250k-2M (exhaustive mode)
+6. S_CAP/R_CAP cap sweep on the adversarial configs
+7. per-stage profile via the in-kernel probe cuts (shared driver with
+   scripts/probe_stages.py) — VERDICT r4 next-2's on-chip attribution
+
+Recommended one-grant order: 0 1 2 7 3 4 5 6 (cheap liveness first,
+headline + profile before the long sweeps).
 
 Usage: python scripts/tpu_session.py [phases…]   (default: 1 2 3)
 """
@@ -48,9 +55,11 @@ def phase1():
 
 
 def phase2():
+    # production mode (exhaustive), matching bench.py's headline; the
+    # fused order check still gates the result independently
     ops = workloads.chain_workload(64, 1_000_000)
     stats = runner.time_merge(
-        ops, repeats=5, progress=True,
+        ops, repeats=5, progress=True, hints="exhaustive",
         expected_ts=workloads.chain_expected_ts(64, 1_000_000))
     out({"phase": 2, "headline_1M": stats})
 
@@ -100,7 +109,7 @@ def phase3():
 
 
 def phase4():
-    rows = runner.run(repeats=3)
+    rows = runner.run(repeats=3, hints="exhaustive")
     out({"phase": 4, "sweep": rows})
 
 
@@ -108,7 +117,8 @@ def phase5():
     rows = []
     for n in (250_000, 500_000, 1_000_000, 2_000_000):
         stats = runner.time_merge(workloads.chain_workload(64, n),
-                                  repeats=3, audit=False)
+                                  repeats=3, audit=False,
+                                  hints="exhaustive")
         rows.append({"n_ops": stats["n_ops"], "p50_ms": stats["p50_ms"],
                      "ops_per_sec": stats["ops_per_sec"]})
         log(f"scale {n}: {stats['p50_ms']} ms")
@@ -144,6 +154,19 @@ def phase6():
         os.environ.pop(name, None)
     jax.clear_caches()
     out({"phase": 6, "cap_sweep": rows})
+
+
+def phase7():
+    """Per-stage profile via the in-kernel probe cuts (VERDICT r4
+    next-2; cuts are cumulative/nested, ops/merge.py ``probe=``) — the
+    SAME driver loop as scripts/probe_stages.py (imported, so the
+    on-chip and CPU profiles cannot diverge), stages 1-8 including the
+    clean full kernel.  Run after phase 2 so the compile cache is
+    warm."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import probe_stages
+    rows = probe_stages.profile(1_000_000, log=log)
+    out({"phase": 7, "stage_profile": rows})
 
 
 if __name__ == "__main__":
