@@ -210,6 +210,33 @@ def _split_ts(t: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return hi, lo
 
 
+# v5e has no native int64: XLA emulates it, and emulated SCATTERS are the
+# one catastrophically slow case (~120-140 ms per M-wide scatter at 1M on
+# the live chip vs ~nothing for int32; gathers and elementwise i64 are
+# fine — scripts/probe_stage12.py).  Every scatter of an i64 value array
+# therefore runs as TWO int32 scatters of the bit halves below, repacked
+# elementwise afterwards.
+
+BIG_HI = BIG >> 32                       # unbiased bit halves of BIG
+BIG_LO_BIASED = (BIG & 0xFFFFFFFF) - 2**31
+
+
+def _split_u(t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """int64 → (hi, lo) raw int32 bit halves (no bias — equality and
+    repack exact for 0 <= t < 2^63; NOT order-preserving)."""
+    return (t >> 32).astype(jnp.int32), (t & 0xFFFFFFFF).astype(jnp.int32)
+
+
+def _pack_u(h: jax.Array, l: jax.Array) -> jax.Array:
+    """Inverse of :func:`_split_u` (elementwise, cheap on TPU)."""
+    return (h.astype(jnp.int64) << 32) | (l.astype(jnp.int64) & 0xFFFFFFFF)
+
+
+def _pack_biased(h: jax.Array, l: jax.Array) -> jax.Array:
+    """Inverse of :func:`_split_ts` (biased low halves, sort keys)."""
+    return (h.astype(jnp.int64) << 32) | (l.astype(jnp.int64) + 2**31)
+
+
 def _fix_and(ok: jax.Array, ptr: jax.Array, cap: int) -> jax.Array:
     """AND of ``ok`` over every ancestor along ``ptr`` chains (terminal
     slots self-loop).  Pointer doubling with early exit: 0 trips when all
@@ -282,9 +309,16 @@ def _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL):
     op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
         ~run_start & not_big, unique_indices=True)
     tgt = jnp.where(is_canon, slot_of_sorted, M)
-    node_ts = jnp.full(M, BIG, jnp.int64).at[tgt].set(
-        sorted_ts, mode="drop", unique_indices=True) \
-        .at[ROOT].set(0).at[NULL].set(BIG)
+    # i64 scatter → two i32 scatters of the sorted (hi, lo-biased) halves
+    # (already materialised by the sort network), packed elementwise:
+    # ROOT's ts 0 splits to (0, -2^31) under the bias
+    nts_h = jnp.full(M, BIG_HI, jnp.int32).at[tgt].set(
+        s_hi, mode="drop", unique_indices=True) \
+        .at[ROOT].set(0).at[NULL].set(BIG_HI)
+    nts_l = jnp.full(M, BIG_LO_BIASED, jnp.int32).at[tgt].set(
+        s_lo, mode="drop", unique_indices=True) \
+        .at[ROOT].set(-2**31).at[NULL].set(BIG_LO_BIASED)
+    node_ts = _pack_biased(nts_h, nts_l)
     node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
         sorted_pos, mode="drop", unique_indices=True)
     is_node_slot = jnp.zeros(M, bool).at[tgt].set(
@@ -504,9 +538,16 @@ def _materialize(ops: Dict[str, jax.Array],
         # exactly one canonical per used slot (row indices are unique), so
         # these scatters are parallel-path even under hostile ranks
         tgt_op = jnp.where(is_canon_op, op_slot_r, M)
-        node_ts_r = jnp.full(M, BIG, jnp.int64).at[tgt_op].set(
-            ts, mode="drop", unique_indices=True) \
-            .at[ROOT].set(0).at[NULL].set(BIG)
+        # i64 scatter → two i32 scatters of the ts bit halves (biased low,
+        # matching the sorted construction), packed elementwise
+        ts_h, ts_l = _split_ts(ts)
+        nth_r = jnp.full(M, BIG_HI, jnp.int32).at[tgt_op].set(
+            ts_h, mode="drop", unique_indices=True) \
+            .at[ROOT].set(0).at[NULL].set(BIG_HI)
+        ntl_r = jnp.full(M, BIG_LO_BIASED, jnp.int32).at[tgt_op].set(
+            ts_l, mode="drop", unique_indices=True) \
+            .at[ROOT].set(-2**31).at[NULL].set(BIG_LO_BIASED)
+        node_ts_r = _pack_biased(nth_r, ntl_r)
         node_pos_r = jnp.full(M, IPOS, jnp.int32).at[tgt_op].set(
             pos, mode="drop", unique_indices=True)
         is_node_slot_r = jnp.zeros(M, bool).at[tgt_op].set(
@@ -608,8 +649,15 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
     node_depth = scat_c(jnp.zeros(M, jnp.int32), depth).at[ROOT].set(0)
     node_value_ref = scat_c(jnp.full(M, -1, jnp.int32), value_ref)
-    node_claimed = jnp.zeros((M, D), jnp.int64).at[tgt_c].set(
-        paths, mode="drop", unique_indices=True)
+    # the path planes stay SPLIT as raw int32 bit halves through every
+    # compare below (prefix + delete-target checks are pure equality) and
+    # repack to the i64 output plane once at the end — the [M, D] i64
+    # scatters here were the kernel's costliest single ops on v5e
+    paths_h, paths_l = _split_u(paths)
+    claimed_h = jnp.zeros((M, D), jnp.int32).at[tgt_c].set(
+        paths_h, mode="drop", unique_indices=True)
+    claimed_l = jnp.zeros((M, D), jnp.int32).at[tgt_c].set(
+        paths_l, mode="drop", unique_indices=True)
     node_anchor_is_sentinel = scat_c(jnp.zeros(M, bool), anchor_ts == 0)
     pslot = scat_c(jnp.full(M, NULL, jnp.int32), pp_slot)
     aslot = scat_c(jnp.full(M, NULL, jnp.int32), aa_slot)
@@ -622,8 +670,12 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # Full materialised path: claimed anchor path with the node's own ts
     # in the last position (Internal/Node.elm:79-82).
     col = jnp.clip(node_depth - 1, 0, D - 1)
-    fp = node_claimed.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, node_ts, node_claimed[slot_ids, col]),
+    nts_h, nts_l = _split_u(node_ts)
+    fp_h = claimed_h.at[slot_ids, col].set(
+        jnp.where(node_depth > 0, nts_h, claimed_h[slot_ids, col]),
+        unique_indices=True)
+    fp_l = claimed_l.at[slot_ids, col].set(
+        jnp.where(node_depth > 0, nts_l, claimed_l[slot_ids, col]),
         unique_indices=True)
 
     # ---- 5. Local validity per node slot: the claimed prefix must exactly
@@ -632,7 +684,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # must be a sibling (same parent), depths must chain.
     prefix_ok = jnp.all(
         jnp.where(cols < node_depth[:, None] - 1,
-                  node_claimed == fp[pslot], True), axis=1)
+                  (claimed_h == fp_h[pslot]) & (claimed_l == fp_l[pslot]),
+                  True), axis=1)
     depth_ok = (node_depth >= 1) & (node_depth <= D) & \
         (node_depth == node_depth[pslot] + 1)
     parent_ok = pfound & depth_ok & prefix_ok
@@ -641,7 +694,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
     local_ok = local_ok.at[ROOT].set(True)
     if probe is not None:
-        acc = acc + _probe_sum(local_ok, parent_ok, fp)
+        acc = acc + _probe_sum(local_ok, parent_ok, fp_h, fp_l)
         if probe == 2:
             return acc
 
@@ -708,7 +761,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         d_depth_ok = (depth >= 1) & (depth <= D) & \
             (node_depth[d_tslot] == depth)
         d_path_ok = jnp.all(
-            jnp.where(cols < depth[:, None], paths == fp[d_tslot], True),
+            jnp.where(cols < depth[:, None],
+                      (paths_h == fp_h[d_tslot]) &
+                      (paths_l == fp_l[d_tslot]), True),
             axis=1)
         d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
             d_depth_ok & d_path_ok
@@ -1070,7 +1125,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
     return NodeTable(
         ts=node_ts, parent=parent_eff, depth=node_depth,
-        value_ref=node_value_ref, paths=fp, exists=exists, tombstone=tomb,
+        value_ref=node_value_ref, paths=_pack_u(fp_h, fp_l),
+        exists=exists, tombstone=tomb,
         dead=dead, visible=visible, doc_index=doc_index, order=order,
         visible_order=visible_order,
         num_nodes=jnp.sum(exists).astype(jnp.int32),

@@ -1,0 +1,155 @@
+"""Focused on-chip probe for the merge kernel's stage-1/2 cost (the two
+stages that dominate on v5e: 308 + 316 ms of the 663 ms clean kernel,
+SWEEP_TPU_r05).  Each row isolates ONE suspect at headline width
+(N = 1M, D = 1 — the chain workload's real plane shape):
+
+- non-unique scatter-min (stage 1's canonical-winner scatter: the one
+  scatter the kernel cannot mark unique_indices),
+- i64 vs i32 vs hi/lo-paired random gathers and unique scatters (every
+  stage-1/2 value array is i64; v5e emulates i64),
+- the full _res_hint composite (3 gathers + compare) in i64 vs hi/lo,
+- the stage-2 plane sequence (claimed scatter, fp overwrite, fp[pslot]
+  prefix gather) in i64 vs hi/lo form.
+
+Honest timing throughout (dispatch + forced readback of a dependent
+scalar); print the floor first and subtract it mentally from every row.
+
+Usage: python scripts/probe_stage12.py [N] [--cpu]   (default 1_000_000)
+
+--cpu scrubs the TPU plugin env BEFORE jax imports (sitecustomize pins
+the tunnel platform, so a bare JAX_PLATFORMS=cpu is silently overridden
+— running this without --cpu while another client holds the grant
+violates the serial-client discipline).
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    # load by FILE PATH: a package import would pull crdt_graph_tpu/
+    # __init__ (which imports jax) before the scrub — the same trap
+    # tests/conftest.py documents
+    import importlib.util
+    import os
+    _spec = importlib.util.spec_from_file_location(
+        "_hostenv", os.path.join(os.path.dirname(__file__), "..",
+                                 "crdt_graph_tpu", "utils", "hostenv.py"))
+    _hostenv = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_hostenv)
+    _hostenv.scrub_tpu_env(1)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from crdt_graph_tpu.utils import compcache
+compcache.enable()
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import honest
+
+
+def row(name, fn, *args, repeats=3):
+    f = jax.jit(fn)
+    s = honest.time_with_readback(f, *args, repeats=repeats)
+    print(f"{name:40s} p50 {s['p50_ms']:8.1f} ms  min {s['min_ms']:8.1f}"
+          f"  (warm {s['warm_ms']/1e3:.1f}s)", flush=True)
+    return s["p50_ms"]
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    M = N + 2
+    rng = np.random.default_rng(0)
+    fp = honest.fingerprint
+
+    idx = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))      # hint/p
+    pslot = jnp.asarray(rng.integers(0, M, M, dtype=np.int32))
+    ts64 = jnp.asarray(rng.integers(1, 2**40, N, dtype=np.int64))
+    want64 = jnp.asarray(rng.integers(1, 2**40, N, dtype=np.int64))
+    i32a = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    rowi = jnp.asarray(np.arange(N, dtype=np.int32))
+    slot = jnp.asarray(rng.integers(0, M, N, dtype=np.int32))
+    badd = jnp.asarray(rng.integers(0, 2, N).astype(bool))
+
+    tsh = (ts64 >> 32).astype(jnp.int32)
+    tsl = (ts64 & 0xFFFFFFFF).astype(jnp.int32)
+    wanth = (want64 >> 32).astype(jnp.int32)
+    wantl = (want64 & 0xFFFFFFFF).astype(jnp.int32)
+
+    print(f"N={N}  floor={honest.overhead_floor_ms()} ms", flush=True)
+
+    # -- the stage-1 suspects, one primitive each -------------------------
+    row("gather N<-N i32", lambda a, i: fp(a[i]), i32a, idx)
+    row("gather N<-N i64", lambda a, i: fp(a[i]), ts64, idx)
+    row("gather N<-N hi/lo 2x i32", lambda h, l, i: fp((h[i], l[i])),
+        tsh, tsl, idx)
+    row("gather N<-N bool", lambda a, i: fp(a[i]), badd, idx)
+    row("scatter-set M i32 unique", lambda v, s: fp(
+        jnp.zeros(M, jnp.int32).at[s].set(v, mode="drop",
+                                          unique_indices=True)),
+        i32a, slot)
+    row("scatter-set M i64 unique", lambda v, s: fp(
+        jnp.zeros(M, jnp.int64).at[s].set(v, mode="drop",
+                                          unique_indices=True)),
+        ts64, slot)
+    row("scatter-set M hi/lo 2x i32", lambda h, l, s: fp((
+        jnp.zeros(M, jnp.int32).at[s].set(h, mode="drop",
+                                          unique_indices=True),
+        jnp.zeros(M, jnp.int32).at[s].set(l, mode="drop",
+                                          unique_indices=True))),
+        tsh, tsl, slot)
+    row("scatter-min M i32 DUP (stage1 win)", lambda v, s: fp(
+        jnp.full(M, 2**31 - 1, jnp.int32).at[s].min(v, mode="drop")),
+        rowi, slot)
+    row("scatter-set M i32 DUP-safe", lambda v, s: fp(
+        jnp.zeros(M, jnp.int32).at[s].set(v, mode="drop")), i32a, slot)
+
+    # -- the _res_hint composite (x3 in stage 1) --------------------------
+    def res_hint_i64(ts, want, i):
+        p = jnp.clip(i, 0, N - 1)
+        ok = (i >= 0) & (ts[p] == want) & (want > 0)
+        return fp((jnp.where(ok, p, -1), ok))
+
+    def res_hint_hilo(th, tl, wh, wl, i):
+        p = jnp.clip(i, 0, N - 1)
+        ok = (i >= 0) & (th[p] == wh) & (tl[p] == wl) & \
+            ((wh > 0) | (wl > 0))
+        return fp((jnp.where(ok, p, -1), ok))
+
+    row("res_hint i64 (1 of stage1's 3)", res_hint_i64, ts64, want64, idx)
+    row("res_hint hi/lo i32", res_hint_hilo, tsh, tsl, wanth, wantl, idx)
+
+    # -- the stage-2 plane sequence at D=1 --------------------------------
+    def stage2_i64(paths, s, ps, ts):
+        claimed = jnp.zeros(M, jnp.int64).at[s].set(
+            paths, mode="drop", unique_indices=True)
+        fpl = jnp.where(ts > 0, ts, claimed)        # fp col overwrite
+        pref = claimed == fpl[ps]                   # prefix gather+compare
+        return fp((fpl, pref))
+
+    def stage2_hilo(ph, pl, s, ps, th, tl):
+        ch = jnp.zeros(M, jnp.int32).at[s].set(ph, mode="drop",
+                                               unique_indices=True)
+        cl = jnp.zeros(M, jnp.int32).at[s].set(pl, mode="drop",
+                                               unique_indices=True)
+        fh = jnp.where(th > 0, th, ch)
+        fl = jnp.where(th > 0, tl, cl)
+        pref = (ch == fh[ps]) & (cl == fl[ps])
+        return fp((fh, fl, pref))
+
+    mts64 = jnp.asarray(rng.integers(0, 2**40, M, dtype=np.int64))
+    mh = (mts64 >> 32).astype(jnp.int32)
+    ml = (mts64 & 0xFFFFFFFF).astype(jnp.int32)
+    row("stage2 planes i64 (D=1)", stage2_i64, ts64, slot, pslot, mts64)
+    row("stage2 planes hi/lo i32", stage2_hilo, tsh, tsl, slot, pslot,
+        mh, ml)
+
+    # -- checksum self-cost at stage-1 operand count ----------------------
+    row("fingerprint 11 arrays (probe acc)", lambda a, b: fp(
+        (a, b, a, b, a, b, a, b, a, b, a)), ts64, i32a)
+
+
+if __name__ == "__main__":
+    main()
