@@ -47,23 +47,28 @@ def fingerprint(tree: Any) -> jax.Array:
     Call inside jit so the checksum rides the same dispatch as the
     computation; reading back the resulting scalar then forces the whole
     graph.  Cost: one pass of cheap reductions, negligible next to the
-    computation being timed — int64 leaves fold as two int32 halves
-    (v5e emulates 64-bit arithmetic; a wide modulo would bill the
-    HARNESS, not the kernel, for emulation cost).
+    computation being timed.  Honesty needs DATA DEPENDENCE on every
+    element, not collision resistance, so the fold is a wrapping int32
+    weighted sum (odd per-half weight): int64 leaves fold as two int32
+    bit halves and no element ever meets a modulo — v5e emulates 64-bit
+    arithmetic AND has no hardware integer divide, so a wide ``%`` would
+    bill the HARNESS ~200 ms/1M-op table to the kernel being timed
+    (measured round 5: audit readback-after-sleep 265 ms vs 71 ms floor).
     """
-    split64 = jax.default_backend() == "tpu"   # CPU modulo is native/fast
-    s = jnp.int64(0)
+    s = jnp.int32(0)
+    k = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         a = jnp.asarray(leaf)
         if not jnp.issubdtype(a.dtype, jnp.integer):   # bool/float/...
             a = a.astype(jnp.int32)
-        if a.dtype == jnp.int64 and split64:
+        if a.dtype == jnp.int64:
             halves = ((a >> 32).astype(jnp.int32),
                       a.astype(jnp.uint32).astype(jnp.int32))
         else:
-            halves = (a,)
+            halves = (a.astype(jnp.int32),)
         for h in halves:
-            s = s + jnp.sum((h % 1000003).astype(jnp.int64))
+            k += 1
+            s = s + jnp.sum(h * jnp.int32(2 * k + 1), dtype=jnp.int32)
     return s
 
 
