@@ -112,8 +112,15 @@ def _resolve_local(N: int, M: int, *cols):
     op_is_dup = has_rank & ~is_canon
 
     tgt_c = jnp.where(is_canon, op_slot, M)
-    node_ts = jnp.full(M, BIG, jnp.int64).at[tgt_c].set(
-        ts, mode="drop", unique_indices=True)
+    # i64 scatter → two i32 bit-half scatters (v5e-emulated i64 scatters
+    # are the kernel's pathological op, ops/merge.py); repack BEFORE the
+    # pmin — min of packed values is not (min hi, min lo) per half
+    ts_h, ts_l = merge_mod._split_ts(ts)
+    nth = jnp.full(M, merge_mod.BIG_HI, jnp.int32).at[tgt_c].set(
+        ts_h, mode="drop", unique_indices=True)
+    ntl = jnp.full(M, merge_mod.BIG_LO_BIASED, jnp.int32).at[tgt_c].set(
+        ts_l, mode="drop", unique_indices=True)
+    node_ts = merge_mod._pack_biased(nth, ntl)
     node_ts = lax.pmin(node_ts, OPS_AXIS)
     node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt_c].set(
         pos.astype(jnp.int32), mode="drop", unique_indices=True)
