@@ -94,6 +94,7 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
     _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
     p50_s = stats["p50_ms"] / 1e3
+    floor_ms = honest.overhead_floor_ms()
     out = {
         "n_ops": n,
         "p50_ms": stats["p50_ms"],
@@ -101,7 +102,12 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         "compile_ms": stats["warm_ms"],
         "num_nodes": int(num_nodes),
         "num_visible": int(num_visible),
-        "dispatch_overhead_ms": honest.overhead_floor_ms(),
+        "dispatch_overhead_ms": floor_ms,
+        # the axon tunnel's dispatch+readback RTT sits INSIDE every honest
+        # repeat (~70 ms; a same-host deployment would not pay it).  p50
+        # stays the headline; this is the kernel-side residue for the
+        # roofline argument, not a substitute headline.
+        "p50_minus_rtt_ms": round(max(stats["p50_ms"] - floor_ms, 0.0), 2),
     }
     if expected_ts is not None:
         out["order_exact"] = bool(order_ok)

@@ -29,7 +29,9 @@ if "--cpu" in sys.argv:
     sys.argv.remove("--cpu")
     # load by FILE PATH: a package import would pull crdt_graph_tpu/
     # __init__ (which imports jax) before the scrub — the same trap
-    # tests/conftest.py documents
+    # tests/conftest.py documents.  force_cpu_devices (not just the env
+    # scrub) is required: the sitecustomize plugin registration survives
+    # the env scrub and wins unless jax_platforms is overridden too.
     import importlib.util
     import os
     _spec = importlib.util.spec_from_file_location(
@@ -37,7 +39,7 @@ if "--cpu" in sys.argv:
                                  "crdt_graph_tpu", "utils", "hostenv.py"))
     _hostenv = importlib.util.module_from_spec(_spec)
     _spec.loader.exec_module(_hostenv)
-    _hostenv.scrub_tpu_env(1)
+    _hostenv.force_cpu_devices(1)
 
 import numpy as np
 import jax

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Post-fix on-chip batch for the NEXT tunnel grant, strictly serial in
+# one process chain (two clients deadlock the grant).  Order = value per
+# granted minute: headline + stage profile first, then the full sweep,
+# scale, cap tuning, then clean primitive probes.
+#
+# Usage: bash scripts/tpu_next_grant.sh [outdir]   (default /tmp)
+OUT=${1:-/tmp}
+cd /root/repo
+{
+  echo "=== tpu_session 2 7 4 5 6 $(date -u +%H:%M:%S) ==="
+  timeout 3600 python scripts/tpu_session.py 2 7 4 5 6 \
+    >> "$OUT/tpu_postfix.jsonl" 2>> "$OUT/tpu_postfix.err"
+  echo "=== probe_stage12 $(date -u +%H:%M:%S) ==="
+  timeout 900 python scripts/probe_stage12.py 1000000 \
+    >> "$OUT/tpu_probe12.txt" 2>&1
+  echo "=== probe_prims $(date -u +%H:%M:%S) ==="
+  timeout 900 python scripts/probe_prims.py 1000000 \
+    >> "$OUT/tpu_prims.txt" 2>&1
+  echo "=== done $(date -u +%H:%M:%S) ==="
+} >> "$OUT/tpu_next_grant.log" 2>&1
