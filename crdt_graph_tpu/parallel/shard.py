@@ -133,22 +133,22 @@ def _resolve_local(N: int, M: int, *cols):
 
     # ---- boundary exchange: the shard summary every other shard needs
     # to answer timestamp references into this shard (hint columns hold
-    # GLOBAL rows).  13 bytes/op, one tiled all-gather; all resolution
+    # GLOBAL rows).  12 bytes/op, one tiled all-gather (is_add and
+    # op_slot travel pre-fused, merge._pack_slot_or_neg); all resolution
     # gathers below are then local.
     ts_g = lax.all_gather(ts, OPS_AXIS, tiled=True)
-    is_add_g = lax.all_gather(is_add, OPS_AXIS, tiled=True)
-    op_slot_g = lax.all_gather(op_slot, OPS_AXIS, tiled=True)
+    son_g = lax.all_gather(
+        merge_mod._pack_slot_or_neg(is_add, op_slot), OPS_AXIS,
+        tiled=True)
 
-    res = functools.partial(merge_mod._res_hint_impl, is_add=is_add_g,
+    res = functools.partial(merge_mod._res_hint_impl, slot_or_neg=son_g,
                             ts=ts_g, N=N, ROOT=ROOT, NULL=NULL)
     pp_slot, pp_found, pp_miss = res(
-        parent_pos.astype(jnp.int32), parent_ts.astype(jnp.int64),
-        op_slot_g)
+        parent_pos.astype(jnp.int32), parent_ts.astype(jnp.int64))
     aa_slot, aa_found, aa_miss = res(
-        anchor_pos.astype(jnp.int32), anchor_ts.astype(jnp.int64),
-        op_slot_g)
+        anchor_pos.astype(jnp.int32), anchor_ts.astype(jnp.int64))
     tt_slot, tt_found, tt_miss = res(
-        target_pos.astype(jnp.int32), ts, op_slot_g)
+        target_pos.astype(jnp.int32), ts)
 
     # ---- distributed rank/link verification (the stock kernel's auto
     # mode, violation counts joined by psum): node-frame properties are
@@ -172,6 +172,9 @@ def _resolve_local(N: int, M: int, *cols):
     # all-gather each — this is where auto-partitioning would have
     # inserted its own gathers around the tail's scatters)
     gath = lambda x: lax.all_gather(x, OPS_AXIS, tiled=True)  # noqa: E731
+    # global op_slot column, recovered elementwise from the fused
+    # slot-or-neg summary (non-Add rows carried op_slot == NULL locally)
+    op_slot_g = jnp.where(son_g >= 0, son_g, NULL).astype(jnp.int32)
     sel = (op_slot_g, gath(op_is_dup), node_ts, node_pos,
            is_node_slot, gath(pp_slot), gath(aa_slot), gath(tt_slot),
            gath(pp_found), gath(aa_found), gath(tt_found))
