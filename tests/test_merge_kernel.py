@@ -524,3 +524,47 @@ def test_verify_hints_audits_rank_and_links():
     assert not mutated(target_pos=t)
     pp = p.parent_pos.copy(); pp[3] = 2         # wrong row for d's parent
     assert not mutated(parent_pos=pp)
+
+
+# -- int32 bit-half discipline (round 5): every i64 scatter runs as two
+# i32 half scatters (v5e-emulated i64 scatters measured ~25x an i32
+# scatter, SWEEP_TPU_r05_prefix).  These pin the wrap/bias edges: low
+# halves >= 2^31 (negative as raw int32), and values adjacent to the
+# BIG sentinel's bit pattern.
+
+def test_split_pack_roundtrip_edges():
+    import jax
+    import jax.numpy as jnp
+    vals = np.array([0, 1, 2**31 - 1, 2**31, 2**32 - 1, 2**32,
+                     2**32 + 2**31, 5 * 2**32 + (2**32 - 1),
+                     merge.BIG - 1, merge.BIG], dtype=np.int64)
+    with jax.enable_x64(True):     # bare asarray would truncate to i32
+        v = jnp.asarray(vals)
+        h, l = merge._split_u(v)
+        assert np.array_equal(np.asarray(merge._pack_u(h, l)), vals)
+        hb, lb = merge._split_ts(v)
+        assert np.array_equal(
+            np.asarray(merge._pack_biased(hb, lb)), vals)
+    # biased halves preserve order as a 2-key comparison
+    order = np.lexsort((np.asarray(lb), np.asarray(hb)))
+    assert np.array_equal(vals[order], np.sort(vals))
+
+
+def test_high_low_half_timestamps_converge():
+    """Counters >= 2^31 put the ts LOW half in negative int32 territory
+    for both the biased (sort keys) and raw (fp planes) splits; the
+    kernel (any delivery order — SET semantics) must agree with the
+    oracle's causal-order fold exactly there."""
+    hi = 2**31  # counter crossing the int32 sign boundary
+    ops = [Add(1 * OFFSET + hi, (0,), "a"),
+           Add(1 * OFFSET + hi + 1, (1 * OFFSET + hi,), "b"),
+           Add(2 * OFFSET + 5, (0,), "c"),
+           Add(2 * OFFSET + hi + 7, (2 * OFFSET + 5,), "d"),
+           Add(1 * OFFSET + 3, (0,), "e")]
+    exp, _ = oracle_visible(ops)       # causal order for the oracle
+    for seed in range(6):
+        rng = random.Random(seed)
+        shuffled = ops[:]
+        rng.shuffle(shuffled)
+        vis, _, _ = kernel_visible(shuffled)
+        assert vis == exp, f"seed {seed}: {vis} != {exp}"
