@@ -656,7 +656,14 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     def scat_c(init, vals):
         return init.at[tgt_c].set(vals, mode="drop", unique_indices=True)
 
-    node_depth = scat_c(jnp.zeros(M, jnp.int32), depth).at[ROOT].set(0)
+    # small per-node fields ride fused into few int32 scatters (each
+    # M-wide scatter has a fixed per-element cost on v5e, so fewer,
+    # wider-payload scatters win): depth(5b)+anchor-sentinel(1b) in one,
+    # each slot ref (21b) with its found bit in one.
+    ds_pack = scat_c(jnp.zeros(M, jnp.int32),
+                     (depth << 1) | (anchor_ts == 0))
+    node_depth = (ds_pack >> 1).at[ROOT].set(0)
+    node_anchor_is_sentinel = (ds_pack & 1).astype(bool)
     node_value_ref = scat_c(jnp.full(M, -1, jnp.int32), value_ref)
     # the path planes stay SPLIT as raw int32 bit halves through every
     # compare below (prefix + delete-target checks are pure equality) and
@@ -667,25 +674,25 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         paths_h, mode="drop", unique_indices=True)
     claimed_l = jnp.zeros((M, D), jnp.int32).at[tgt_c].set(
         paths_l, mode="drop", unique_indices=True)
-    node_anchor_is_sentinel = scat_c(jnp.zeros(M, bool), anchor_ts == 0)
-    pslot = scat_c(jnp.full(M, NULL, jnp.int32), pp_slot)
-    aslot = scat_c(jnp.full(M, NULL, jnp.int32), aa_slot)
-    pfound = scat_c(jnp.zeros(M, bool), pp_found)
-    afound = scat_c(jnp.zeros(M, bool), aa_found)
+    pf_pack = scat_c(jnp.full(M, NULL << 1, jnp.int32),
+                     (pp_slot << 1) | pp_found)
+    af_pack = scat_c(jnp.full(M, NULL << 1, jnp.int32),
+                     (aa_slot << 1) | aa_found)
+    pslot, pfound = pf_pack >> 1, (pf_pack & 1).astype(bool)
+    aslot, afound = af_pack >> 1, (af_pack & 1).astype(bool)
     d_tslot, d_tfound = tt_slot, tt_found
     dp_slot, dp_found = pp_slot, pp_found
     pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
 
     # Full materialised path: claimed anchor path with the node's own ts
-    # in the last position (Internal/Node.elm:79-82).
+    # in the last position (Internal/Node.elm:79-82).  The row index of
+    # this update is the identity, so it lowers as a one-hot elementwise
+    # select over the plane, never a scatter.
     col = jnp.clip(node_depth - 1, 0, D - 1)
     nts_h, nts_l = _split_u(node_ts)
-    fp_h = claimed_h.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, nts_h, claimed_h[slot_ids, col]),
-        unique_indices=True)
-    fp_l = claimed_l.at[slot_ids, col].set(
-        jnp.where(node_depth > 0, nts_l, claimed_l[slot_ids, col]),
-        unique_indices=True)
+    put = (cols == col[:, None]) & (node_depth[:, None] > 0)
+    fp_h = jnp.where(put, nts_h[:, None], claimed_h)
+    fp_l = jnp.where(put, nts_l[:, None], claimed_l)
 
     # ---- 5. Local validity per node slot: the claimed prefix must exactly
     # match the parent's materialised path (what "descending the path"
