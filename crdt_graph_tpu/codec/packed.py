@@ -176,14 +176,18 @@ def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
     trust a persisted vouch over possibly stale/corrupt columns).
 
     True iff (a) ``ts_rank`` equals a fresh ``compute_ts_rank`` over the
-    loaded kind/ts columns and (b) every nonzero in-batch-resolvable
+    loaded kind/ts columns, (b) every nonzero in-batch-resolvable
     reference (parent for every real op, anchor for adds, target for
     deletes) carries a hint that verifies (points at an add row whose
-    ``ts`` equals the referenced timestamp).  These are the properties
-    the kernel's auto mode re-derives on device (ops/merge.py rank/link
-    verification); when they hold, exhaustive and auto are semantically
-    identical, so a batch passing this check may keep the cond-free
-    path.
+    ``ts`` equals the referenced timestamp), and (c) every nonzero
+    UNRESOLVABLE reference carries ``-1`` — no stray hints.  (a)+(b)
+    are the properties the kernel's auto mode re-derives on device
+    (ops/merge.py rank/link verification); (c) is what the exhaustive
+    mode's check-free resolution additionally trusts (it resolves
+    ``hint >= 0`` without the per-hint ts gather,
+    merge._res_hint_impl ``check_ts=False``).  When all three hold,
+    exhaustive and auto are semantically identical, so a batch passing
+    this check may keep the cond-free path.
 
     ``check_rank=False`` skips (a) — for callers whose PackedOps was
     built WITHOUT a ts_rank column (``__post_init__`` computed it from
@@ -208,7 +212,14 @@ def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
             in_batch = uniq[i] == want
         else:
             in_batch = np.zeros(want.shape, bool)
-        return bool(np.all(~(nonzero & in_batch) | verified))
+        # resolvable refs must verify, AND unresolvable refs must carry
+        # -1 (no stray hints): every producer emits -1 on lookup miss,
+        # and the kernel's exhaustive mode relies on it — it resolves
+        # ``hint >= 0`` WITHOUT the per-hint ts check gather
+        # (merge._res_hint_impl check_ts=False), so a stray hint there
+        # would silently mis-resolve instead of landing NOT_FOUND
+        return bool(np.all(np.where(nonzero & in_batch, verified,
+                                    ~nonzero | in_batch | (hint < 0))))
 
     return (_refs_ok(p.kind != KIND_PAD, p.parent_ts, p.parent_pos)
             and _refs_ok(is_add, p.anchor_ts, p.anchor_pos)

@@ -58,7 +58,7 @@ bottleneck):
   reference with one verified gather.  In auto mode both hint families
   are re-verified on device — properties that hold iff the hints are
   exactly right — and any violation routes the batch through the
-  sort+join construction via ``lax.cond`` (same 11-tuple interface, all
+  sort+join construction via ``lax.cond`` (same 10-tuple interface, all
   downstream stages path-agnostic), so wrong hints cost speed, never
   correctness.  Slot ids compare like timestamps everywhere downstream;
   no int64 feeds a sort or a pointer loop.
@@ -279,17 +279,17 @@ def _fix_min(val: jax.Array, ptr: jax.Array, active: jax.Array,
 
 def _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL):
     """Sort-based slot assignment (see the SORTED+JOIN contract in
-    ``_materialize``): the first five tuple entries plus the sorted
+    ``_materialize``): the first six tuple entries plus the sorted
     timestamp axis the join needs.  Module-level so the explicitly
     partitioned resolve (parallel/shard.py) shares the one
     implementation with the whole-array kernel."""
     sort_ts = jnp.where(is_add & (ts > 0), ts, BIG)
     ts_hi, ts_lo = _split_ts(sort_ts)
-    # stable sort: equal timestamps keep batch order; pos re-derives
-    # by one gather — cheaper than a fourth array through the network
+    # stable sort: equal timestamps keep batch order; per-node fields
+    # re-derive by gathers through node_row — cheaper than more arrays
+    # through the sort network
     s_hi, s_lo, sorted_idx = lax.sort(
         (ts_hi, ts_lo, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
-    sorted_pos = pos[sorted_idx]
     sorted_ts = (s_hi.astype(jnp.int64) << 32) | \
         (s_lo.astype(jnp.int64) + 2**31)
     run_start = jnp.concatenate(
@@ -308,32 +308,32 @@ def _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL):
         jnp.where(not_big, slot_of_sorted, NULL), unique_indices=True)
     op_is_dup = jnp.zeros(N, bool).at[sorted_idx].set(
         ~run_start & not_big, unique_indices=True)
+    # canonical SOURCE ROW per slot (original batch order), the one
+    # node-frame scatter this construction keeps: every other node
+    # column derives by gathering the canonical row's op fields through
+    # it — M-wide scatters have a large fixed per-element cost on v5e
+    # while random gathers are far cheaper (scripts/probe_prims.py)
     tgt = jnp.where(is_canon, slot_of_sorted, M)
-    # i64 scatter → two i32 scatters of the sorted (hi, lo-biased) halves
-    # (already materialised by the sort network), packed elementwise:
-    # ROOT's ts 0 splits to (0, -2^31) under the bias
-    nts_h = jnp.full(M, BIG_HI, jnp.int32).at[tgt].set(
-        s_hi, mode="drop", unique_indices=True) \
-        .at[ROOT].set(0).at[NULL].set(BIG_HI)
-    nts_l = jnp.full(M, BIG_LO_BIASED, jnp.int32).at[tgt].set(
-        s_lo, mode="drop", unique_indices=True) \
-        .at[ROOT].set(-2**31).at[NULL].set(BIG_LO_BIASED)
-    node_ts = _pack_biased(nts_h, nts_l)
-    node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
-        sorted_pos, mode="drop", unique_indices=True)
-    is_node_slot = jnp.zeros(M, bool).at[tgt].set(
-        is_canon, mode="drop", unique_indices=True)
+    node_row = jnp.full(M, IPOS, jnp.int32).at[tgt].set(
+        sorted_idx, mode="drop", unique_indices=True)
+    is_node_slot, node_ts, node_pos = _node_cols_from_row(
+        node_row, sort_ts, pos, M, ROOT, N)
     return (op_slot, op_is_dup, node_ts, node_pos,
-            is_node_slot), sorted_ts
+            is_node_slot, node_row), sorted_ts
 
 
-def _join_ops_impl(sorted_ts, parent_ts, anchor_ts, ts, N, ROOT, NULL):
-    """Per-op sort-merge join (3N queries: parent, anchor, own-ts
+def _join_ops_impl(sorted_ts, parent_ts, at_ts, N, ROOT, NULL):
+    """Per-op sort-merge join (2N queries: parent and anchor-or-target
     against the sorted add axis; method="sort": the per-query binary
-    search was 1.67 s device time at 1M ops on v5e).  Module-level so
+    search was 1.67 s device time at 1M ops on v5e).  ``at_ts`` is the
+    FUSED anchor/target column — anchor ts for Add rows, own (target) ts
+    for Delete rows: downstream consumes the anchor resolution only at
+    canonical Add rows and the target resolution only at Delete rows
+    (_finish: af_pack scatter / d_tslot), so one resolution serves both
+    and the join shrinks from 3N to 2N queries.  Module-level so
     hint-verified merges can defer it into a cond branch that never
     executes, and so parallel/shard.py's fallback shares it."""
-    queries = jnp.concatenate([parent_ts, anchor_ts, ts])
+    queries = jnp.concatenate([parent_ts, at_ts])
     qidx = jnp.searchsorted(sorted_ts, queries, side="left",
                             method="sort").astype(jnp.int32)
     qidx_c = jnp.minimum(qidx, N - 1)
@@ -343,12 +343,39 @@ def _join_ops_impl(sorted_ts, parent_ts, anchor_ts, ts, N, ROOT, NULL):
                       jnp.where(qhit, qidx_c + 1, NULL)) \
         .astype(jnp.int32)
     qfound = (queries == 0) | qhit
-    return (qslot[:N], qslot[N:2 * N], qslot[2 * N:],
-            qfound[:N], qfound[N:2 * N], qfound[2 * N:])
+    return (qslot[:N], qslot[N:],
+            qfound[:N], qfound[N:])
+
+
+def _at_ts(is_add, anchor_ts, ts):
+    """The fused anchor-or-target timestamp column (see
+    :func:`_join_ops_impl`)."""
+    return jnp.where(is_add, anchor_ts, ts)
+
+
+def _node_cols_from_row(node_row, src_ts, src_pos, M, ROOT, N):
+    """Node-frame columns by GATHER through the canonical source row.
+
+    ``node_row`` (i32[M], ≥ N ⇒ unused slot) is the one scattered frame
+    each construction keeps; the ts/pos columns derive from it with one
+    gather each instead of one scatter each (M-wide scatters have a
+    large fixed per-element cost on v5e while random gathers are far
+    cheaper — scripts/probe_prims.py).  Shared by the ranked path, the
+    sorted fallback, and parallel/shard.py so the three constructions
+    cannot drift (their bit-identity is a pinned contract,
+    tests/test_shard_map.py).  Unused slots: ts = BIG (sorts last),
+    pos = IPOS; ROOT's ts overridden to 0."""
+    is_node_slot = node_row < jnp.int32(N)
+    wc = jnp.where(is_node_slot, node_row, 0)
+    node_ts = jnp.where(is_node_slot, src_ts[wc], BIG)
+    node_ts = jnp.where(jnp.arange(M, dtype=jnp.int32) == ROOT,
+                        jnp.int64(0), node_ts)
+    node_pos = jnp.where(is_node_slot, src_pos[wc], IPOS)
+    return is_node_slot, node_ts, node_pos
 
 
 def _resolve_sorted(ops: Dict[str, jax.Array]):
-    """The full SORTED+JOIN resolution: the 11-tuple interface from raw
+    """The full SORTED+JOIN resolution: the 10-tuple interface from raw
     op columns, hint-free.  The whole-array kernel's fallback branch and
     parallel/shard.py's post-gather fallback both call this."""
     kind = ops["kind"]
@@ -358,10 +385,12 @@ def _resolve_sorted(ops: Dict[str, jax.Array]):
     pos = ops["pos"].astype(jnp.int32)
     N = kind.shape[0]
     M = N + 2
+    is_add = kind == KIND_ADD
     slots, sorted_ts = _sorted_slots_impl(
-        kind == KIND_ADD, ts, pos, N, M, 0, M - 1)
+        is_add, ts, pos, N, M, 0, M - 1)
     return slots + _join_ops_impl(
-        sorted_ts, parent_ts, anchor_ts, ts, N, 0, M - 1)
+        sorted_ts, parent_ts, _at_ts(is_add, anchor_ts, ts),
+        N, 0, M - 1)
 
 
 def _pack_slot_or_neg(is_add, op_slot_arr):
@@ -374,18 +403,30 @@ def _pack_slot_or_neg(is_add, op_slot_arr):
     return jnp.where(is_add, op_slot_arr, -1).astype(jnp.int32)
 
 
-def _res_hint_impl(hint, want, slot_or_neg, ts, N, ROOT, NULL):
+def _res_hint_impl(hint, want, slot_or_neg, ts, N, ROOT, NULL,
+                   check_ts: bool = True):
     """One link-hint resolution: verified int32 gather (see the
     RANKED+HINTED contract in ``_materialize``).  ``miss`` flags any
     nonzero reference without a verified hint.  ``slot_or_neg`` (from
     :func:`_pack_slot_or_neg`) and ``ts`` are the summary columns the
     hint indexes into — the local batch in the whole-array kernel, the
-    all-gathered global batch in parallel/shard.py.  Two gathers per
-    hint: the packed slot column and the timestamp check."""
+    all-gathered global batch in parallel/shard.py.
+
+    ``check_ts=True`` (auto mode) verifies each hint on device with a
+    second gather (``ts[hint] == want``) — required for the "wrong
+    hints cost speed, never correctness" guarantee.  ``check_ts=False``
+    (exhaustive mode) trusts the VOUCHED producer contract — every
+    producer (codec/packed.pack, rebuild_hints, concat, the native
+    parser) emits ``-1`` for any reference with no matching in-batch
+    add row, and ``packed.verify_hints`` re-audits exactly that (incl.
+    no stray out-of-batch hints) on every restore/foreign ingest — so
+    resolution is ONE i32 gather per hint; an M-wide i64 check gather
+    was ~1/6 of the kernel's device time at 1M on v5e."""
     p = jnp.clip(hint, 0, N - 1)
     sp = slot_or_neg[p]
-    ok = (hint >= 0) & (sp >= 0) & (ts[p] == want) & \
-        (want > 0) & (want < BIG)
+    ok = (hint >= 0) & (sp >= 0) & (want > 0) & (want < BIG)
+    if check_ts:
+        ok = ok & (ts[p] == want)
     slot = jnp.where(want == 0, ROOT, jnp.where(ok, sp, NULL))
     miss = (want > 0) & (want < BIG) & ~ok
     return slot.astype(jnp.int32), (want == 0) | ok, miss
@@ -470,7 +511,7 @@ def _materialize(ops: Dict[str, jax.Array],
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
 
     # ---- 1-4. Slot assignment and timestamp→slot resolution.  Two
-    # interchangeable constructions of one interface (the 11-tuple
+    # interchangeable constructions of one interface (the 10-tuple
     # described below); all downstream stages are path-agnostic.
     #
     # SORTED+JOIN (always available): one stable (hi, lo) int32 key sort
@@ -500,15 +541,27 @@ def _materialize(ops: Dict[str, jax.Array],
     # is_node_slot); the rest of the node table is constructed ONCE after
     # selection, so the auto-mode lax.cond never carries the [M, D] path
     # plane or the resolution scatters as operands:
-    #   (op_slot, op_is_dup, node_ts, node_pos, is_node_slot,
-    #    pp_slot, aa_slot, tt_slot, pp_found, aa_found, tt_found)
+    #   (op_slot, op_is_dup, node_ts, node_pos, is_node_slot, node_row,
+    #    pp_slot, at_slot, pp_found, at_found)
+    # ``node_row`` is each used slot's canonical SOURCE ROW (IPOS when
+    # unused): _finish gathers the remaining per-node fields (depth,
+    # value_ref, path plane, resolved links) through it instead of
+    # scattering them — the node-frame construction keeps exactly one
+    # M-wide scatter per path (win / the sorted construction's row
+    # scatter).
+    # ``at`` is the FUSED anchor-or-target resolution (anchor for Add
+    # rows, delete target for Delete rows — see _join_ops_impl): the two
+    # are consumed at disjoint row sets downstream, so resolving them
+    # separately paid one extra M-wide random gather pair per merge.
     # The delete-parent resolution is the per-op parent resolution
     # (dp ≡ pp), so it needs no slots of its own.
+    at_ts = _at_ts(is_add, anchor_ts, ts)
+
     def _sorted_slots():
         return _sorted_slots_impl(is_add, ts, pos, N, M, ROOT, NULL)
 
     def _join_ops(sorted_ts):
-        return _join_ops_impl(sorted_ts, parent_ts, anchor_ts, ts,
+        return _join_ops_impl(sorted_ts, parent_ts, at_ts,
                               N, ROOT, NULL)
 
     def _sorted_ops(_):
@@ -517,14 +570,19 @@ def _materialize(ops: Dict[str, jax.Array],
 
     def _resolve_hinted(op_slot_arr):
         son = _pack_slot_or_neg(is_add, op_slot_arr)
+        # exhaustive mode rides the vouched producer contract and skips
+        # the per-hint ts check gather (_res_hint_impl docstring)
+        check = hints != "exhaustive"
 
         def _res_hint(hint, want):
-            return _res_hint_impl(hint, want, son, ts, N, ROOT, NULL)
+            return _res_hint_impl(hint, want, son, ts, N, ROOT, NULL,
+                                  check_ts=check)
 
         pp = _res_hint(ops["parent_pos"].astype(jnp.int32), parent_ts)
-        aa = _res_hint(ops["anchor_pos"].astype(jnp.int32), anchor_ts)
-        tt = _res_hint(ops["target_pos"].astype(jnp.int32), ts)
-        return pp, aa, tt
+        at = _res_hint(
+            jnp.where(is_add, ops["anchor_pos"].astype(jnp.int32),
+                      ops["target_pos"].astype(jnp.int32)), at_ts)
+        return pp, at
 
     have_link = hints != "join" and all(
         k in ops for k in ("parent_pos", "anchor_pos", "target_pos"))
@@ -544,31 +602,20 @@ def _materialize(ops: Dict[str, jax.Array],
             jnp.where(has_rank, op_slot_r, M)].min(row_idx, mode="drop")
         is_canon_op = has_rank & (row_idx == win[op_slot_r])
         op_is_dup_r = has_rank & ~is_canon_op
-        # exactly one canonical per used slot (row indices are unique), so
-        # these scatters are parallel-path even under hostile ranks
-        tgt_op = jnp.where(is_canon_op, op_slot_r, M)
-        # i64 scatter → two i32 scatters of the ts bit halves (biased low,
-        # matching the sorted construction), packed elementwise
-        ts_h, ts_l = _split_ts(ts)
-        nth_r = jnp.full(M, BIG_HI, jnp.int32).at[tgt_op].set(
-            ts_h, mode="drop", unique_indices=True) \
-            .at[ROOT].set(0).at[NULL].set(BIG_HI)
-        ntl_r = jnp.full(M, BIG_LO_BIASED, jnp.int32).at[tgt_op].set(
-            ts_l, mode="drop", unique_indices=True) \
-            .at[ROOT].set(-2**31).at[NULL].set(BIG_LO_BIASED)
-        node_ts_r = _pack_biased(nth_r, ntl_r)
-        node_pos_r = jnp.full(M, IPOS, jnp.int32).at[tgt_op].set(
-            pos, mode="drop", unique_indices=True)
-        is_node_slot_r = jnp.zeros(M, bool).at[tgt_op].set(
-            jnp.ones(N, bool), mode="drop", unique_indices=True)
+        # Node columns by GATHER through the winner row — the scatter-min
+        # above is the ONE scatter this construction keeps (the former
+        # four M-wide scatters were most of stage 1's 270 ms of the
+        # 396 ms clean kernel on the live chip); win already encodes
+        # exactly which row owns each slot: unused slots (and ROOT/NULL,
+        # which no op targets — slot = rank+1 ∈ [1, N]) keep IPOS.
+        is_node_slot_r, node_ts_r, node_pos_r = _node_cols_from_row(
+            win, ts, pos, M, ROOT, N)
 
         ((pp_slot, pp_found, pp_miss),
-         (aa_slot, aa_found, aa_miss),
-         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(op_slot_r)
+         (at_slot, at_found, at_miss)) = _resolve_hinted(op_slot_r)
         ranked = (op_slot_r, op_is_dup_r, node_ts_r, node_pos_r,
-                  is_node_slot_r,
-                  pp_slot, aa_slot, tt_slot,
-                  pp_found, aa_found, tt_found)
+                  is_node_slot_r, win,
+                  pp_slot, at_slot, pp_found, at_found)
         if hints == "exhaustive":
             sel = ranked
         else:
@@ -583,8 +630,8 @@ def _materialize(ops: Dict[str, jax.Array],
                 jnp.where(has_rank, nts[jnp.clip(op_slot_r, 0, M - 1)]
                           == ts, True))
             all_ranked = jnp.all(~is_real_add | has_rank)
-            link_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
-                jnp.any(tt_miss & is_del)
+            link_miss = jnp.any(pp_miss) | \
+                jnp.any(at_miss & (is_add | is_del))
             hints_ok = dense_ok & incr_ok & ts_match & all_ranked & \
                 ~link_miss
             sel = lax.cond(hints_ok, lambda _: ranked, _sorted_ops, None)
@@ -595,15 +642,13 @@ def _materialize(ops: Dict[str, jax.Array],
         # execute it
         slots, sorted_ts = _sorted_slots()
         ((pp_slot, pp_found, pp_miss),
-         (aa_slot, aa_found, aa_miss),
-         (tt_slot, tt_found, tt_miss)) = _resolve_hinted(slots[0])
-        hinted = (pp_slot, aa_slot, tt_slot,
-                  pp_found, aa_found, tt_found)
+         (at_slot, at_found, at_miss)) = _resolve_hinted(slots[0])
+        hinted = (pp_slot, at_slot, pp_found, at_found)
         if hints == "exhaustive":
             resolution = hinted
         else:
-            any_miss = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
-                jnp.any(tt_miss & is_del)
+            any_miss = jnp.any(pp_miss) | \
+                jnp.any(at_miss & (is_add | is_del))
             resolution = lax.cond(
                 any_miss, lambda _: _join_ops(sorted_ts),
                 lambda _: hinted, None)
@@ -622,7 +667,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             no_deletes: bool, probe: Optional[int] = None,
             acc=None) -> NodeTable:
     """Stages 3-13: node-table construction through per-op statuses,
-    from the resolution interface (the 11-tuple ``sel``).  Extracted
+    from the resolution interface (the 10-tuple ``sel``).  Extracted
     from ``_materialize`` so the explicitly partitioned resolve
     (parallel/shard.py) reuses the exact same downstream trace — bit
     identity across the whole-array and shard_map paths is structural,
@@ -643,44 +688,47 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     cols = jnp.arange(D, dtype=jnp.int32)[None, :]
     is_add = kind == KIND_ADD
     is_del = kind == KIND_DELETE
-    (op_slot, op_is_dup, node_ts, node_pos, is_node_slot,
-     pp_slot, aa_slot, tt_slot, pp_found, aa_found, tt_found) = sel
+    (op_slot, op_is_dup, node_ts, node_pos, is_node_slot, node_row,
+     pp_slot, at_slot, pp_found, at_found) = sel
 
 
     # ---- 3. Node-table construction from the SELECTED assignment —
-    # shared across all branches, outside any cond.  Exactly one
-    # canonical op per used slot, so every scatter is parallel-path.
-    canon = ~op_is_dup & (op_slot != NULL)
-    tgt_c = jnp.where(canon, op_slot, M)
-
-    def scat_c(init, vals):
-        return init.at[tgt_c].set(vals, mode="drop", unique_indices=True)
-
-    # small per-node fields ride fused into few int32 scatters (each
-    # M-wide scatter has a fixed per-element cost on v5e, so fewer,
-    # wider-payload scatters win): depth(5b)+anchor-sentinel(1b) in one,
-    # each slot ref (21b) with its found bit in one.
-    ds_pack = scat_c(jnp.zeros(M, jnp.int32),
-                     (depth << 1) | (anchor_ts == 0))
-    node_depth = (ds_pack >> 1).at[ROOT].set(0)
-    node_anchor_is_sentinel = (ds_pack & 1).astype(bool)
-    node_value_ref = scat_c(jnp.full(M, -1, jnp.int32), value_ref)
+    # shared across all branches, outside any cond, and SCATTER-FREE:
+    # every per-node field is the canonical source row's op field,
+    # gathered through ``node_row`` (M-wide scatters have a large fixed
+    # per-element cost on v5e — stage 2 measured 62 ms of the 396 ms
+    # clean kernel as scatters, scripts/probe_prims.py — while the
+    # whole construction is 3 gathers sharing one index vector).
+    nsr = jnp.where(is_node_slot, node_row, 0)
+    # small per-op fields pre-fused into ONE gatherable i64: hi word =
+    # depth(5b)+anchor-sentinel(1b), lo word = value_ref
+    dsv = _pack_u((depth << 1) | (anchor_ts == 0), value_ref)[nsr]
+    node_depth = jnp.where(is_node_slot, (dsv >> 33).astype(jnp.int32),
+                           0).at[ROOT].set(0)
+    node_anchor_is_sentinel = is_node_slot & \
+        ((dsv >> 32) & 1).astype(bool)
+    node_value_ref = jnp.where(is_node_slot,
+                               (dsv & 0xFFFFFFFF).astype(jnp.int32), -1)
     # the path planes stay SPLIT as raw int32 bit halves through every
     # compare below (prefix + delete-target checks are pure equality) and
-    # repack to the i64 output plane once at the end — the [M, D] i64
-    # scatters here were the kernel's costliest single ops on v5e
-    paths_h, paths_l = _split_u(paths)
-    claimed_h = jnp.zeros((M, D), jnp.int32).at[tgt_c].set(
-        paths_h, mode="drop", unique_indices=True)
-    claimed_l = jnp.zeros((M, D), jnp.int32).at[tgt_c].set(
-        paths_l, mode="drop", unique_indices=True)
-    pf_pack = scat_c(jnp.full(M, NULL << 1, jnp.int32),
-                     (pp_slot << 1) | pp_found)
-    af_pack = scat_c(jnp.full(M, NULL << 1, jnp.int32),
-                     (aa_slot << 1) | aa_found)
+    # repack to the i64 output plane once at the end; one [M, D] i64 row
+    # gather replaces what was the kernel's costliest single scatter pair
+    claimed = jnp.where(is_node_slot[:, None], paths[nsr], 0)
+    claimed_h, claimed_l = _split_u(claimed)
+    # both resolved links (slot(30b)+found(1b) each) in ONE i64 gather;
+    # at_slot/at_found carry the anchor resolution at Add rows and the
+    # delete-target resolution at Delete rows (fused upstream): canon
+    # rows are Adds, so the gathered half sees anchors; d_tslot is read
+    # at Delete rows only (step 7), where the fused column IS the target.
+    pa = _pack_u((pp_slot << 1) | pp_found, (at_slot << 1) | at_found)
+    pa_n = jnp.where(is_node_slot, pa[nsr],
+                     _pack_u(jnp.full(M, NULL << 1, jnp.int32),
+                             jnp.full(M, NULL << 1, jnp.int32)))
+    pf_pack = (pa_n >> 32).astype(jnp.int32)
+    af_pack = (pa_n & 0xFFFFFFFF).astype(jnp.int32)
     pslot, pfound = pf_pack >> 1, (pf_pack & 1).astype(bool)
     aslot, afound = af_pack >> 1, (af_pack & 1).astype(bool)
-    d_tslot, d_tfound = tt_slot, tt_found
+    d_tslot, d_tfound = at_slot, at_found
     dp_slot, dp_found = pp_slot, pp_found
     pslot = jnp.where(slot_ids == ROOT, ROOT, pslot)
 
@@ -776,6 +824,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     else:
         d_depth_ok = (depth >= 1) & (depth <= D) & \
             (node_depth[d_tslot] == depth)
+        paths_h, paths_l = _split_u(paths)   # per-op plane, elementwise
         d_path_ok = jnp.all(
             jnp.where(cols < depth[:, None],
                       (paths_h == fp_h[d_tslot]) &

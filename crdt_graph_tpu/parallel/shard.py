@@ -9,11 +9,13 @@ the resolution stages (slot assignment, duplicate election, timestamp→slot
 reference resolution, hint verification) as ``jax.shard_map`` with the
 communication written out:
 
-- **local slot scatter + min all-reduce**: each shard scatters its ops
-  into an M-wide node frame (slot = ingest rank + 1), and one
-  ``lax.pmin`` per node column (win row, node ts, node pos) joins the
-  frames — the semilattice join of partial node tables, 2·M bytes/device
-  ring traffic each.
+- **local slot scatter + min all-reduce**: each shard scatters its ops'
+  global row indices into an M-wide winner frame (slot = ingest
+  rank + 1), and ONE ``lax.pmin`` joins the frames — the semilattice
+  join of partial node tables, 4·M bytes/device ring traffic.  Every
+  other node column then derives by gathering the winning row's fields
+  from the gathered summaries (the stock ranked path's one-scatter
+  construction; scatters carry a large fixed per-element cost on v5e).
 - **shard-summary all-gather**: link hints are GLOBAL row positions, so
   resolving a cross-shard reference needs the referenced row's
   (ts, is_add, slot) — exactly the "boundary exchange of shard
@@ -77,13 +79,15 @@ _COLS = ("kind", "ts", "parent_ts", "anchor_ts", "depth", "paths",
          "ts_rank")
 
 
-def _resolve_local(N: int, M: int, *cols):
+def _resolve_local(N: int, M: int, vouched: bool, *cols):
     """Per-shard body: local resolution + explicit collectives.
 
     Runs under shard_map with every input sliced along the op axis
     (length N/k rows here); every output is REPLICATED (identical on
     all devices) — node frames by min all-reduce, per-op columns by
-    tiled all-gather.  ``N``/``M`` are the GLOBAL widths."""
+    tiled all-gather.  ``N``/``M`` are the GLOBAL widths; ``vouched``
+    mirrors the stock kernel's exhaustive mode (skip the per-hint ts
+    check gathers, merge._res_hint_impl)."""
     (kind, ts, parent_ts, anchor_ts, depth, paths, value_ref, pos,
      parent_pos, anchor_pos, target_pos, ts_rank) = cols
     ROOT, NULL = 0, M - 1
@@ -111,26 +115,6 @@ def _resolve_local(N: int, M: int, *cols):
     is_canon = has_rank & (row == win[op_slot])
     op_is_dup = has_rank & ~is_canon
 
-    tgt_c = jnp.where(is_canon, op_slot, M)
-    # i64 scatter → two i32 bit-half scatters (v5e-emulated i64 scatters
-    # are the kernel's pathological op, ops/merge.py); repack BEFORE the
-    # pmin — min of packed values is not (min hi, min lo) per half
-    ts_h, ts_l = merge_mod._split_ts(ts)
-    nth = jnp.full(M, merge_mod.BIG_HI, jnp.int32).at[tgt_c].set(
-        ts_h, mode="drop", unique_indices=True)
-    ntl = jnp.full(M, merge_mod.BIG_LO_BIASED, jnp.int32).at[tgt_c].set(
-        ts_l, mode="drop", unique_indices=True)
-    node_ts = merge_mod._pack_biased(nth, ntl)
-    node_ts = lax.pmin(node_ts, OPS_AXIS)
-    node_pos = jnp.full(M, IPOS, jnp.int32).at[tgt_c].set(
-        pos.astype(jnp.int32), mode="drop", unique_indices=True)
-    node_pos = lax.pmin(node_pos, OPS_AXIS)
-    # a slot is used iff its canonical add's ts landed (real adds have
-    # 0 < ts < BIG, and no op scatters to ROOT/NULL: slot = rank+1 ≥ 1
-    # and rank < N ⇒ slot ≤ N < NULL)
-    is_node_slot = node_ts < BIG
-    node_ts = node_ts.at[ROOT].set(0).at[NULL].set(BIG)
-
     # ---- boundary exchange: the shard summary every other shard needs
     # to answer timestamp references into this shard (hint columns hold
     # GLOBAL rows).  12 bytes/op, one tiled all-gather (is_add and
@@ -141,14 +125,28 @@ def _resolve_local(N: int, M: int, *cols):
         merge_mod._pack_slot_or_neg(is_add, op_slot), OPS_AXIS,
         tiled=True)
 
+    # node frame: the joined win row IS the whole frame — every other
+    # node column derives by gathering the canonical row's fields from
+    # the gathered summary (merge._node_cols_from_row, the stock ranked
+    # path's one-scatter construction).  A slot is used iff some row won
+    # it; no op scatters to ROOT/NULL (slot = rank+1 ∈ [1, N]).
+    pos_g = lax.all_gather(pos.astype(jnp.int32), OPS_AXIS, tiled=True)
+    is_node_slot, node_ts, node_pos = merge_mod._node_cols_from_row(
+        win, ts_g, pos_g, M, ROOT, N)
+
     res = functools.partial(merge_mod._res_hint_impl, slot_or_neg=son_g,
-                            ts=ts_g, N=N, ROOT=ROOT, NULL=NULL)
+                            ts=ts_g, N=N, ROOT=ROOT, NULL=NULL,
+                            check_ts=not vouched)
     pp_slot, pp_found, pp_miss = res(
         parent_pos.astype(jnp.int32), parent_ts.astype(jnp.int64))
-    aa_slot, aa_found, aa_miss = res(
-        anchor_pos.astype(jnp.int32), anchor_ts.astype(jnp.int64))
-    tt_slot, tt_found, tt_miss = res(
-        target_pos.astype(jnp.int32), ts)
+    # fused anchor-or-target resolution (merge._join_ops_impl): anchor
+    # for Add rows, delete target for Delete rows — consumed at disjoint
+    # row sets by the tail, so one resolution (and one all-gather pair
+    # below) serves both
+    at_slot, at_found, at_miss = res(
+        jnp.where(is_add, anchor_pos.astype(jnp.int32),
+                  target_pos.astype(jnp.int32)),
+        merge_mod._at_ts(is_add, anchor_ts.astype(jnp.int64), ts))
 
     # ---- distributed rank/link verification (the stock kernel's auto
     # mode, violation counts joined by psum): node-frame properties are
@@ -161,8 +159,8 @@ def _resolve_local(N: int, M: int, *cols):
         jnp.where(has_rank, node_ts[jnp.clip(op_slot, 0, M - 1)] == ts,
                   True))
     all_ranked_l = jnp.all(~is_real_add | has_rank)
-    link_miss_l = jnp.any(pp_miss) | jnp.any(aa_miss & is_add) | \
-        jnp.any(tt_miss & is_del)
+    link_miss_l = jnp.any(pp_miss) | \
+        jnp.any(at_miss & (is_add | is_del))
     viol = (~ts_match_l).astype(jnp.int32) + \
         (~all_ranked_l).astype(jnp.int32) + link_miss_l.astype(jnp.int32)
     hints_ok = dense_ok & incr_ok & (lax.psum(viol, OPS_AXIS) == 0)
@@ -176,13 +174,13 @@ def _resolve_local(N: int, M: int, *cols):
     # slot-or-neg summary (non-Add rows carried op_slot == NULL locally)
     op_slot_g = jnp.where(son_g >= 0, son_g, NULL).astype(jnp.int32)
     sel = (op_slot_g, gath(op_is_dup), node_ts, node_pos,
-           is_node_slot, gath(pp_slot), gath(aa_slot), gath(tt_slot),
-           gath(pp_found), gath(aa_found), gath(tt_found))
+           is_node_slot, win, gath(pp_slot), gath(at_slot),
+           gath(pp_found), gath(at_found))
     gathered = {
         "kind": gath(kind), "ts": ts_g,
         "parent_ts": gath(parent_ts), "anchor_ts": gath(anchor_ts),
         "depth": gath(depth), "paths": gath(paths),
-        "value_ref": gath(value_ref), "pos": gath(pos),
+        "value_ref": gath(value_ref), "pos": pos_g,
     }
     return gathered, sel, hints_ok
 
@@ -194,7 +192,8 @@ def _shard_materialize_jit(device_ops, mesh: Mesh, hints: str,
                            use_pallas, no_deletes: bool) -> NodeTable:
     N = device_ops["kind"].shape[0]
     M = N + 2
-    body = functools.partial(_resolve_local, N, M)
+    body = functools.partial(_resolve_local, N, M,
+                             hints == "exhaustive")
     spec = [P(OPS_AXIS) if device_ops[c].ndim == 1 else P(OPS_AXIS, None)
             for c in _COLS]
     resolve = jax.shard_map(body, mesh=mesh, in_specs=tuple(spec),

@@ -8,15 +8,25 @@ subtract it mentally from every row.
 
 Usage: python scripts/probe_prims.py [N]   (default 1_000_000)
 """
+import os
 import sys
 import time
 
 sys.path.insert(0, "/root/repo")
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # CPU smoke run: scrub the force-registered TPU plugin before any
+    # backend init, or this process dials the (possibly wedged) tunnel
+    from crdt_graph_tpu.utils import hostenv
+    hostenv.scrub_tpu_env(1)
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 from crdt_graph_tpu.utils import compcache
 compcache.enable()
@@ -84,6 +94,28 @@ def main():
     row("searchsorted 4N in N (sort)", lambda a, q: fp(
         jnp.searchsorted(a, q, method="sort")),
         jnp.sort(i64N), jnp.concatenate([i64N, i64N, i64N, i64N]))
+    # ---- hint-resolution layout candidates (stage 1 = 270 ms on-chip:
+    # which of these dominates decides the next rewrite)
+    row("gather 2xN sep i32 same idx", lambda a, b, i: fp((a[i], b[i])),
+        i32a, i32b, idxN)
+    row("gather i64-as-2xi32 halves N", lambda a, i: fp(
+        ((a >> 32).astype(jnp.int32)[i],
+         (a & 0xFFFFFFFF).astype(jnp.int32)[i])), i64N, idxN)
+    row("gather stack[3,N] col i32", lambda a, b, c, i: fp(
+        jnp.stack([a, b, c])[:, i]), i32a, i32b, i32c, idxN)
+    row("gather stack[N,3] row i32", lambda a, b, c, i: fp(
+        jnp.stack([a, b, c], axis=-1)[i]), i32a, i32b, i32c, idxN)
+    row("gather [N,8] i64 plane row", lambda p, i: fp(p[i]),
+        jnp.tile(i64N[:, None], (1, 8)), idxN)
+    row("scatter-set M i32 (drop)", lambda a, i: fp(
+        jnp.zeros(a.shape[0] + 2, jnp.int32).at[i].set(
+            a, mode="drop", unique_indices=True)), i32a, idxN)
+    row("scatter [N,8] i32 plane", lambda v, i: fp(
+        jnp.zeros((v.shape[0] + 2, 8), jnp.int32).at[i].set(
+            jnp.tile(v[:, None], (1, 8)), mode="drop",
+            unique_indices=True)), i32a, idxN)
+    row("reduction sum 4xN i32", lambda a: fp(
+        (jnp.sum(a), jnp.sum(a * 2), jnp.sum(a ^ 3), jnp.max(a))), i32a)
 
 
 if __name__ == "__main__":

@@ -66,7 +66,7 @@ def main():
     args = [device_ops[c] for c in shard_mod._COLS]
 
     # --- resolve-only: the shard_map'd phase, checksum-forced
-    body = functools.partial(shard_mod._resolve_local, N, M)
+    body = functools.partial(shard_mod._resolve_local, N, M, False)
     resolve = jax.shard_map(body, mesh=mesh,
                             in_specs=tuple(
                                 P(OPS_AXIS) if device_ops[c].ndim == 1

@@ -526,6 +526,35 @@ def test_verify_hints_audits_rank_and_links():
     assert not mutated(parent_pos=pp)
 
 
+def test_verify_hints_rejects_stray_out_of_batch_hint():
+    """Property (c): an UNRESOLVABLE reference must carry -1.  The
+    exhaustive kernel resolves ``hint >= 0`` without the per-hint ts
+    check gather (merge._res_hint_impl check_ts=False), so a stray
+    hint on an out-of-batch reference would silently resolve to an
+    unrelated node instead of landing NOT_FOUND — verify_hints (run on
+    every restore/foreign ingest) must therefore reject it, and auto
+    mode must still converge to the oracle via the cond fallback."""
+    import dataclasses as dc
+    ops = [Add(1, (0,), "a"), Add(2, (1,), "b"),
+           Add(6, (5, 0), "x")]          # parent ts 5 not in batch
+    p = packed.pack(ops)
+    assert packed.verify_hints(p)
+    assert p.parent_pos[2] == -1
+    sp = p.parent_pos.copy()
+    sp[2] = 0                            # stray: points at the ts-1 row
+    q = dc.replace(p, parent_pos=sp)
+    assert not packed.verify_hints(q)
+    # auto mode re-verifies on device: the stray hint fails the link
+    # check and the whole batch routes through sort+join — same tree
+    # as the untampered batch
+    t_ok = view.to_host(merge.materialize(p.arrays(), hints="auto"))
+    t_bad = view.to_host(merge.materialize(q.arrays(), hints="auto"))
+    assert view.visible_values(t_ok, p.values) == \
+        view.visible_values(t_bad, q.values)
+    assert view.statuses(t_ok, p.num_ops) == \
+        view.statuses(t_bad, q.num_ops)
+
+
 # -- int32 bit-half discipline (round 5): every i64 scatter runs as two
 # i32 half scatters (v5e-emulated i64 scatters measured ~25x an i32
 # scatter, SWEEP_TPU_r05_prefix).  These pin the wrap/bias edges: low
