@@ -24,13 +24,13 @@ communication written out:
 - **replicated tail**: the downstream stages (validity cascade, tombstone
   propagation, Euler tour, run-contracted ranking — merge._finish) run
   replicated on every device from the reduced node frame.  This is a
-  MEASURED trade, not a guess (VERDICT r4 next-3): per-stage kernel
-  cuts put resolution at ~45% and the tail at ~55% of a 1M single-chip
-  merge, capping this schedule at ~1.6× on 8 chips; the fully sharded
-  tail is designed (segmented-scan rid, searchsorted compaction,
-  replicated ≤32k-wide Wyllie core) with a ~4× Amdahl ceiling, and the
-  docs axis delivers 8× today — data, model, design and the committed
-  conclusion live in docs/SHARD_TAIL.md, instruments in
+  MEASURED trade, not a guess (VERDICT r4 next-3; updated with the r5
+  on-chip shares and the post-rewrite CPU-proxy split, tail ≈ 76% ⇒
+  single-merge ceiling ~1.3–1.6× on 8 chips): the fully sharded tail
+  is designed (segmented-scan rid, searchsorted compaction, replicated
+  ≤32k-wide Wyllie core) with a ~4× Amdahl ceiling, and the docs axis
+  delivers 8× today — data, model, design and the committed conclusion
+  live in docs/SHARD_TAIL.md (§2b for round 5), instruments in
   scripts/probe_stages.py (kernel ``probe=`` cuts) and
   scripts/probe_shard_stages.py.
   The full op columns are all-gathered once inside the shard_map (the
