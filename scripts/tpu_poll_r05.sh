@@ -1,9 +1,10 @@
 #!/bin/bash
-# Round-5 tunnel poll: one 60s TPU attempt every ~4 min, up to 150 tries
-# (~10h — bounded to end BEFORE the driver's round-end bench window; see
-# memory: a stray probe client can deadlock the grant against the
-# driver's own attempt).  Exits 0 the moment a probe succeeds (marker
-# /tmp/tpu_ok), 1 when the budget is exhausted.
+# Round-5 tunnel poll: one 60s TPU attempt every ~3 min, up to 150 tries.
+# Strictly serial: single probe process; on the first success it touches
+# /tmp/tpu_ok and IMMEDIATELY execs the staged measurement batch
+# (scripts/tpu_next_grant.sh) as the same single client chain — grant
+# windows have been 8-42 min, so waiting for a human-scale check-in
+# wastes the scarcest resource.  Exits 1 when the budget is exhausted.
 LOG=/tmp/tpu_poll_r05.log
 rm -f /tmp/tpu_ok
 for i in $(seq 1 150); do
@@ -14,10 +15,10 @@ x = jax.device_put(np.arange(8, dtype=np.int32))
 print(int(np.asarray(jax.device_get(jax.jit(lambda v: jnp.sum(v+1))(x)))))
 " >> "$LOG" 2>&1; then
     touch /tmp/tpu_ok
-    echo "TPU OK at $(date +%H:%M:%S)" >> "$LOG"
-    exit 0
+    echo "TPU OK at $(date +%H:%M:%S) - launching batch" >> "$LOG"
+    exec bash /root/repo/scripts/tpu_next_grant.sh /tmp
   fi
-  sleep 180
+  sleep 150
 done
 echo "r05: TPU never granted" >> "$LOG"
 exit 1
