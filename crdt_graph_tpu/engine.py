@@ -680,6 +680,15 @@ class TpuTree:
         predecessor, or the first tombstone of a leading tombstone run, or
         the target's own path when it heads the chain."""
         m = self._ensure_mirror()
+        if path and path[-1] == 0:
+            # branch-head sentinel target: the reference resolves it to the
+            # branch's head TOMBSTONE (children dicts are seeded with
+            # ``0 -> Tombstone``, Internal/Node.elm:48; descendant/child
+            # return it, Internal/Node.elm:284-299), nothing's next-sibling
+            # is ever the chain head, so pathPrevious defaults to the
+            # target path (CRDTree.elm:199-216) — the delete itself then
+            # absorbs as AlreadyApplied and the cursor stays put
+            return path
         idx = m.get_slot(tuple(path))
         if idx is not None and m.tomb[idx]:
             # tombstoned target: the reference probe (next-visible == target)

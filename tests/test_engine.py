@@ -322,3 +322,80 @@ def test_apply_packed_matches_apply_on_random_sessions(monkeypatch):
         monkeypatch.undo()
         assert c.visible_values() == a.visible_values(), seed
         assert c.log_length == a.log_length, seed
+
+
+def test_sentinel_delete_is_noop_and_cursor_stays():
+    """Deleting at a branch-head sentinel path (cursor inside an empty
+    branch) absorbs as AlreadyApplied — children dicts are seeded with
+    ``0 -> Tombstone`` (Internal/Node.elm:48), deleteHelp answers
+    AlreadyApplied for tombstones (Internal/Node.elm:112-122) and
+    updateTree maps that to a no-op with ``lastOperation = Batch []``
+    (CRDTree.elm:318-319); no chain member's next-sibling is the chain
+    head, so pathPrevious defaults to the target path and the cursor
+    stays inside the branch (CRDTree.elm:199-216).  Regression: the
+    engine routed the sentinel through the missing-target fallback and
+    parked the cursor at the last visible ROOT sibling, sending every
+    subsequent local edit to the wrong subtree."""
+    t = engine.init(9).add("v").add_branch("b")
+    o = crdt.init(9).add("v").add_branch("b")
+    assert t.cursor == o.cursor and t.cursor[-1] == 0
+    sentinel = list(t.cursor)
+    t.delete(sentinel)
+    o = o.delete(sentinel)
+    assert t.cursor == o.cursor == tuple(sentinel)
+    assert t.last_operation == o.last_operation == Batch(())
+    assert t.visible_values() == o.visible_values()
+    # edits continue INSIDE the branch on both sides
+    t.add("inside")
+    o = o.add("inside")
+    assert t.cursor == o.cursor
+    assert t.visible_values() == o.visible_values()
+
+
+def test_sentinel_delete_missing_branch_fails():
+    """Sentinel path under a branch that does not exist: the DESCENT
+    fails at the missing intermediate, so the reference answers
+    InvalidPath (Internal/Node.elm:156-159, CRDTree.elm:321-322); tree
+    and cursor unchanged."""
+    t = engine.init(9).add("v")
+    o = crdt.init(9).add("v")
+    cur = t.cursor
+    with pytest.raises(crdt.InvalidPathError):
+        t.delete([99 * 2 ** 32 + 1, 0])
+    with pytest.raises(crdt.InvalidPathError):
+        o.delete([99 * 2 ** 32 + 1, 0])
+    assert t.cursor == cur == o.cursor
+    assert t.visible_values() == o.visible_values() == ["v"]
+
+
+def test_random_session_engine_oracle_lockstep():
+    """300-step random local session (adds, branches, deletes at the
+    cursor) driven through BOTH the oracle and the engine: visible
+    values, cursor, and delete outcomes must stay in lockstep — the
+    probe that exposed the sentinel-delete cursor bug."""
+    rng = random.Random(4242)
+    o = crdt.init(9)
+    t = engine.init(9)
+    for i in range(300):
+        r = rng.random()
+        if r < 0.6:
+            o = o.add(f"v{i}")
+            t.add(f"v{i}")
+        elif r < 0.75 and len(o.cursor) < 12:
+            # stay inside the engine's static max_depth=16 path planes
+            o = o.add_branch(f"b{i}")
+            t.add_branch(f"b{i}")
+        elif o.visible_values():
+            p = list(o.cursor)
+            o_ok = e_ok = "ok"
+            try:
+                o = o.delete(p)
+            except (crdt.OperationFailedError, crdt.InvalidPathError) as ex:
+                o_ok = type(ex).__name__
+            try:
+                t.delete(p)
+            except (crdt.OperationFailedError, crdt.InvalidPathError) as ex:
+                e_ok = type(ex).__name__
+            assert o_ok == e_ok, (i, p)
+        assert tuple(o.cursor) == tuple(t.cursor), i
+        assert o.visible_values() == t.visible_values(), i
