@@ -6,7 +6,9 @@ Honest timing: each repeat is dispatch + forced readback of a dependent
 scalar (bench.honest); the per-call floor (tunnel RPC) is printed first —
 subtract it mentally from every row.
 
-Usage: python scripts/probe_prims.py [N]   (default 1_000_000)
+Usage: python scripts/probe_prims.py [N] [FROM]   (default 1_000_000 0)
+``FROM`` skips the first FROM rows — resume a probe list a closed grant
+window cut short without re-paying the compiles of rows already measured.
 """
 import os
 import sys
@@ -35,7 +37,15 @@ jax.config.update("jax_enable_x64", True)
 from crdt_graph_tpu.bench import honest
 
 
+_ROW_START = 0
+_ROW_NUM = 0
+
+
 def row(name, fn, *args, repeats=3):
+    global _ROW_NUM
+    _ROW_NUM += 1
+    if _ROW_NUM <= _ROW_START:
+        return None
     f = jax.jit(fn)
     s = honest.time_with_readback(f, *args, repeats=repeats)
     print(f"{name:34s} p50 {s['p50_ms']:8.1f} ms  min {s['min_ms']:8.1f}"
@@ -44,7 +54,9 @@ def row(name, fn, *args, repeats=3):
 
 
 def main():
+    global _ROW_START
     N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    _ROW_START = int(sys.argv[2]) if len(sys.argv) > 2 else 0
     M = N + 2
     T = 2 * M
     rng = np.random.default_rng(0)

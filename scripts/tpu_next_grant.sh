@@ -1,11 +1,13 @@
 #!/bin/bash
 # Post-rewrite on-chip batch for the NEXT tunnel grant, strictly serial
 # in one process chain (two clients deadlock the grant).  Order = value
-# per granted minute, learned from the two r5 windows (8 and 42 min):
-#   1. headline + stage profile (the judge-facing number + attribution)
-#   2. probe_prims (primitive costs decide the NEXT kernel rewrite:
-#      scatter-per-update vs narrow-gather overhead, stacked-gather
-#      layouts — cheap, one process, many small compiles)
+# per granted minute, learned from the three r5 windows (42, 8, 10 min):
+#   1. headline + stage profile (judge-facing number; now measured with
+#      the batched 1-buffer readback — the old 4-buffer readback billed
+#      ~210 ms of serialized tunnel RTTs to every repeat)
+#   2. remaining probe_prims rows 17-24 (stacked/planar gather layouts:
+#      whether shared-index gathers can be packed decides the next
+#      stage-1/2 rewrite; rows 1-16 are measured, PRIMS_TPU_r05.txt)
 #   3. full 8-config sweep, scale sweep, cap tuning (phase 6 is the
 #      recompile-heavy wedge magnet — last on purpose)
 #
@@ -16,8 +18,8 @@ cd /root/repo
   echo "=== tpu_session 2 7 $(date -u +%H:%M:%S) ==="
   timeout 1800 python scripts/tpu_session.py 2 7 \
     >> "$OUT/tpu_postfix.jsonl" 2>> "$OUT/tpu_postfix.err"
-  echo "=== probe_prims $(date -u +%H:%M:%S) ==="
-  timeout 1200 python scripts/probe_prims.py 1000000 \
+  echo "=== probe_prims from-row-16 $(date -u +%H:%M:%S) ==="
+  timeout 900 python scripts/probe_prims.py 1000000 16 \
     >> "$OUT/tpu_prims.txt" 2>&1
   echo "=== tpu_session 4 5 6 $(date -u +%H:%M:%S) ==="
   timeout 2400 python scripts/tpu_session.py 4 5 6 \

@@ -7,7 +7,11 @@
 # wastes the scarcest resource.  Exits 1 when the budget is exhausted.
 LOG=/tmp/tpu_poll_r05.log
 rm -f /tmp/tpu_ok
-for i in $(seq 1 150); do
+# 120 probes x (60 s probe + 150 s sleep) = 7.0 h worst-case poll, plus
+# the exec'd batch's summed timeouts (6000 s = 1.67 h) = 8.7 h — inside
+# the ~10 h bound that keeps a stray client clear of the driver's
+# round-end bench window (r4 lesson: two clients deadlock the grant)
+for i in $(seq 1 120); do
   echo "r05 probe $i $(date +%H:%M:%S)" >> "$LOG"
   if timeout 60 python -c "
 import numpy as np, jax, jax.numpy as jnp
