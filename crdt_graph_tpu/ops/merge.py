@@ -158,6 +158,25 @@ def _env_cap(name: str, default: int) -> int:
 S_CAP_DEFAULT = 1 << 16   # crowded-sibling sort width (merge._finish)
 R_CAP_DEFAULT = 1 << 15   # run-pipeline compact width (merge._finish)
 
+
+def _pack_gather_on() -> bool:
+    """Trace-time flag GRAFT_PACK_GATHER: gathers that share an index
+    vector ride ONE multi-column plane row-gather instead of one gather
+    per column.  Every M-wide random gather costs ~6 ms of device time
+    at 1M on v5e regardless of payload width (scripts/probe_prims.py:
+    all single primitives sit at the tunnel-RTT floor; the while-loop
+    row isolates the per-gather cost), so IF row-gathers price like one
+    gather this removes ~4 of the ~10 memory ops in stages 1-2.  Whether
+    they do is exactly what prims rows 17-24 (stacked/planar layouts)
+    measure — default OFF until that A/B lands; bit-identity of the two
+    layouts is pinned by tests/test_merge_kernel.py either way.  Same
+    trace-time caveats as _env_cap (logged on every retrace)."""
+    import logging
+    import os
+    on = os.environ.get("GRAFT_PACK_GATHER", "") not in ("", "0")
+    logging.getLogger(__name__).info("trace-time GRAFT_PACK_GATHER=%d", on)
+    return on
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class NodeTable:
@@ -367,10 +386,17 @@ def _node_cols_from_row(node_row, src_ts, src_pos, M, ROOT, N):
     pos = IPOS; ROOT's ts overridden to 0."""
     is_node_slot = node_row < jnp.int32(N)
     wc = jnp.where(is_node_slot, node_row, 0)
-    node_ts = jnp.where(is_node_slot, src_ts[wc], BIG)
+    if _pack_gather_on():
+        # one [N, 2] i64 row gather instead of two column gathers
+        src = jnp.stack([src_ts, src_pos.astype(jnp.int64)], axis=-1)
+        g = src[wc]
+        got_ts, got_pos = g[:, 0], g[:, 1].astype(jnp.int32)
+    else:
+        got_ts, got_pos = src_ts[wc], src_pos[wc]
+    node_ts = jnp.where(is_node_slot, got_ts, BIG)
     node_ts = jnp.where(jnp.arange(M, dtype=jnp.int32) == ROOT,
                         jnp.int64(0), node_ts)
-    node_pos = jnp.where(is_node_slot, src_pos[wc], IPOS)
+    node_pos = jnp.where(is_node_slot, got_pos, IPOS)
     return is_node_slot, node_ts, node_pos
 
 
@@ -702,7 +728,23 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     nsr = jnp.where(is_node_slot, node_row, 0)
     # small per-op fields pre-fused into ONE gatherable i64: hi word =
     # depth(5b)+anchor-sentinel(1b), lo word = value_ref
-    dsv = _pack_u((depth << 1) | (anchor_ts == 0), value_ref)[nsr]
+    dsv_src = _pack_u((depth << 1) | (anchor_ts == 0), value_ref)
+    # both resolved links (slot(30b)+found(1b) each) in ONE i64 gather;
+    # at_slot/at_found carry the anchor resolution at Add rows and the
+    # delete-target resolution at Delete rows (fused upstream): canon
+    # rows are Adds, so the gathered half sees anchors; d_tslot is read
+    # at Delete rows only (step 7), where the fused column IS the target.
+    pa = _pack_u((pp_slot << 1) | pp_found, (at_slot << 1) | at_found)
+    if _pack_gather_on():
+        # all three nsr-indexed gathers ride one [N, D+2] i64 plane row
+        plane = jnp.concatenate(
+            [dsv_src[:, None], pa[:, None], paths], axis=1)
+        g = plane[nsr]
+        dsv, pa_g, claimed_raw = g[:, 0], g[:, 1], g[:, 2:]
+    else:
+        dsv = dsv_src[nsr]
+        pa_g = pa[nsr]
+        claimed_raw = paths[nsr]
     node_depth = jnp.where(is_node_slot, (dsv >> 33).astype(jnp.int32),
                            0).at[ROOT].set(0)
     node_anchor_is_sentinel = is_node_slot & \
@@ -713,15 +755,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # compare below (prefix + delete-target checks are pure equality) and
     # repack to the i64 output plane once at the end; one [M, D] i64 row
     # gather replaces what was the kernel's costliest single scatter pair
-    claimed = jnp.where(is_node_slot[:, None], paths[nsr], 0)
+    claimed = jnp.where(is_node_slot[:, None], claimed_raw, 0)
     claimed_h, claimed_l = _split_u(claimed)
-    # both resolved links (slot(30b)+found(1b) each) in ONE i64 gather;
-    # at_slot/at_found carry the anchor resolution at Add rows and the
-    # delete-target resolution at Delete rows (fused upstream): canon
-    # rows are Adds, so the gathered half sees anchors; d_tslot is read
-    # at Delete rows only (step 7), where the fused column IS the target.
-    pa = _pack_u((pp_slot << 1) | pp_found, (at_slot << 1) | at_found)
-    pa_n = jnp.where(is_node_slot, pa[nsr],
+    pa_n = jnp.where(is_node_slot, pa_g,
                      _pack_u(jnp.full(M, NULL << 1, jnp.int32),
                              jnp.full(M, NULL << 1, jnp.int32)))
     pf_pack = (pa_n >> 32).astype(jnp.int32)
@@ -746,12 +782,25 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # match the parent's materialised path (what "descending the path"
     # validates in the reference, Internal/Node.elm:138-163), the anchor
     # must be a sibling (same parent), depths must chain.
+    if _pack_gather_on():
+        # parent path plane + parent depth in one [M, D+1] i64 row
+        # gather through pslot; the fp repack below (the kernel's output
+        # plane, line ~1229) is the same _pack_u expression, so XLA CSEs
+        # it — the pack itself costs nothing extra
+        pplane = jnp.concatenate(
+            [_pack_u(fp_h, fp_l), node_depth[:, None].astype(jnp.int64)],
+            axis=1)[pslot]
+        par_h, par_l = _split_u(pplane[:, :D])
+        par_depth = pplane[:, D].astype(jnp.int32)
+    else:
+        par_h, par_l = fp_h[pslot], fp_l[pslot]
+        par_depth = node_depth[pslot]
     prefix_ok = jnp.all(
         jnp.where(cols < node_depth[:, None] - 1,
-                  (claimed_h == fp_h[pslot]) & (claimed_l == fp_l[pslot]),
+                  (claimed_h == par_h) & (claimed_l == par_l),
                   True), axis=1)
     depth_ok = (node_depth >= 1) & (node_depth <= D) & \
-        (node_depth == node_depth[pslot] + 1)
+        (node_depth == par_depth + 1)
     parent_ok = pfound & depth_ok & prefix_ok
     anchor_ok = node_anchor_is_sentinel | \
         (afound & (pslot[aslot] == pslot) & (aslot != ROOT))

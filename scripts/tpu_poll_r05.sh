@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-5 tunnel poll: one 60s TPU attempt every ~3 min, up to 150 tries.
+# Round-5 tunnel poll: one 60s TPU attempt every ~3.5 min, up to 120 tries.
 # Strictly serial: single probe process; on the first success it touches
 # /tmp/tpu_ok and IMMEDIATELY execs the staged measurement batch
 # (scripts/tpu_next_grant.sh) as the same single client chain — grant
@@ -8,7 +8,7 @@
 LOG=/tmp/tpu_poll_r05.log
 rm -f /tmp/tpu_ok
 # 120 probes x (60 s probe + 150 s sleep) = 7.0 h worst-case poll, plus
-# the exec'd batch's summed timeouts (6000 s = 1.67 h) = 8.7 h — inside
+# the exec'd batch's summed timeouts (7800 s = 2.17 h) = 9.2 h — inside
 # the ~10 h bound that keeps a stray client clear of the driver's
 # round-end bench window (r4 lesson: two clients deadlock the grant)
 for i in $(seq 1 120); do

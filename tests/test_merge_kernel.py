@@ -597,3 +597,48 @@ def test_high_low_half_timestamps_converge():
         rng.shuffle(shuffled)
         vis, _, _ = kernel_visible(shuffled)
         assert vis == exp, f"seed {seed}: {vis} != {exp}"
+
+
+def test_pack_gather_layout_bit_identity(monkeypatch):
+    """GRAFT_PACK_GATHER routes the shared-index gathers of stages 1-2
+    through multi-column plane row-gathers (merge._pack_gather_on); the
+    two layouts are exact integer re-packings, so every NodeTable field
+    must be bit-identical across them in all three hint modes.  The flag
+    is read at trace time, so the caches are cleared between settings."""
+    import jax
+
+    rng = random.Random(77)
+    o = crdt.init(5)
+    for i in range(300):
+        r = rng.random()
+        if r < 0.55:
+            o = o.add(f"v{i}")
+        elif r < 0.7 and len(o.cursor) < 10:
+            o = o.add_branch(f"b{i}")
+        elif o.visible_values():
+            try:
+                o = o.delete(list(o.cursor))
+            except (crdt.OperationFailedError, crdt.InvalidPathError):
+                pass
+    arrs = packed.pack(o.operations_since(0)).arrays()
+    fields = ["ts", "parent", "depth", "value_ref", "paths", "exists",
+              "tombstone", "dead", "visible", "doc_index", "order",
+              "visible_order", "num_nodes", "num_visible", "status"]
+
+    def tables():
+        return {h: view.to_host(merge.materialize(arrs, hints=h))
+                for h in ("exhaustive", "auto", "join")}
+
+    monkeypatch.delenv("GRAFT_PACK_GATHER", raising=False)
+    jax.clear_caches()
+    base = tables()
+    monkeypatch.setenv("GRAFT_PACK_GATHER", "1")
+    jax.clear_caches()
+    packed_t = tables()
+    monkeypatch.undo()
+    jax.clear_caches()
+    for h in base:
+        for f in fields:
+            assert np.array_equal(np.asarray(getattr(base[h], f)),
+                                  np.asarray(getattr(packed_t[h], f))), \
+                (h, f)
