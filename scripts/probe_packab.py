@@ -57,10 +57,21 @@ def main():
             print(json.dumps({"error": "leg timed out (900 s)",
                               "pack_gather": bool(flag)}), flush=True)
             continue
-        out = r.stdout.strip().splitlines()
-        print(out[-1] if out else json.dumps(
-            {"error": r.stderr[-400:], "pack_gather": bool(flag)}),
-            flush=True)
+        # take the last stdout line that parses as JSON (banners/library
+        # prints must not masquerade as the result); otherwise record the
+        # stderr tail so the failure cause survives the grant window
+        result = None
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                result = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if result is None or r.returncode != 0:
+            result = {"error": (r.stderr or r.stdout)[-400:],
+                      "returncode": r.returncode,
+                      "pack_gather": bool(flag)}
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -128,6 +128,29 @@ def main():
             unique_indices=True)), i32a, idxN)
     row("reduction sum 4xN i32", lambda a: fp(
         (jnp.sum(a), jnp.sum(a * 2), jnp.sum(a ^ 3), jnp.max(a))), i32a)
+    # ---- per-HLO fixed overhead vs width (rows 25-27): the 1M rows put
+    # every primitive at ~6 ms; if a 32k gather costs the SAME, the cost
+    # is per-op dispatch/serialization, not throughput — then the
+    # run-compacted tour loops (R_CAP=32k x ~15 Wyllie rounds) price
+    # like full-width ops and chain LENGTH is the only lever anywhere.
+    K = 32_768
+    i32k = jnp.asarray(rng.integers(0, K, K, dtype=np.int32))
+    idxK = jnp.asarray(rng.integers(0, K, K, dtype=np.int32))
+    row("gather 32k<-32k i32", lambda a, i: fp(a[i]), i32k, idxK)
+    row("while_loop 10x (gather 32k)", lambda a, i: fp(
+        lax.while_loop(lambda c: c[0] < 10,
+                       lambda c: (c[0] + 1, c[1][i]),
+                       (jnp.int32(0), a))[1]), i32k, idxK)
+    row("20x dependent elementwise N", lambda a: fp(
+        _chain_elementwise(a, 20)), i32a)
+
+
+def _chain_elementwise(a, k):
+    """k strictly dependent full-width elementwise passes (rotations mix
+    lanes so XLA cannot fold the chain into one op)."""
+    for j in range(k):
+        a = jnp.roll(a, 1) ^ (a + jnp.int32(2 * j + 1))
+    return a
 
 
 if __name__ == "__main__":

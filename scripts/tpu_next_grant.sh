@@ -22,7 +22,9 @@ cd /root/repo
   timeout 900 python scripts/probe_prims.py 1000000 16 \
     >> "$OUT/tpu_prims.txt" 2>&1
   echo "=== probe_packab $(date -u +%H:%M:%S) ==="
-  timeout 1800 python scripts/probe_packab.py 1000000 \
+  # 2 legs x 900 s inner timeout + startup/compile headroom: the outer
+  # bound must exceed the sum or a wedged leg 1 kills leg 2 mid-flight
+  timeout 2100 python scripts/probe_packab.py 1000000 \
     >> "$OUT/tpu_packab.jsonl" 2>> "$OUT/tpu_packab.err"
   echo "=== tpu_session 4 5 6 $(date -u +%H:%M:%S) ==="
   timeout 2400 python scripts/tpu_session.py 4 5 6 \
