@@ -115,10 +115,18 @@ class TableNode:
     @property
     def timestamp(self) -> int:
         m = self._mirror()
-        return 0 if self.is_root else int(m.ts[self._slot])
+        if self.is_root or self._slot < 0:
+            return 0
+        return int(m.ts[self._slot])
 
     @property
     def path(self) -> Tuple[int, ...]:
+        if self._slot < 0:
+            # branch-head sentinel: ONE shared empty-path tombstone seeds
+            # every children dict (Internal/Node.elm:46-48), so its path
+            # accessor answers () regardless of where it was reached —
+            # quirk preserved for oracle parity (core/node.py sentinel)
+            return ()
         return self._mirror().path_of(self._slot)
 
     @property
@@ -130,6 +138,8 @@ class TableNode:
         """Tombstoned directly OR gone with a deleted ancestor branch —
         either way the node left the document (a held view can observe
         this in place, since host edits don't invalidate views)."""
+        if self._slot < 0:
+            return True      # the branch-head sentinel IS a tombstone
         m = self._mirror()
         return bool(m.tomb[self._slot]) or m.is_dead(self._slot)
 
@@ -804,8 +814,21 @@ class TpuTree:
         return TableNode(self, 0)
 
     def get(self, path: Sequence[int]) -> Optional[TableNode]:
-        """Node at ``path`` (tombstones included) or None."""
-        slot = self._slot_at(tuple(path))
+        """Node at ``path`` (tombstones included) or None.  A trailing-0
+        path addresses the branch-head SENTINEL, which exists under the
+        root and under every live node (children dicts are seeded with
+        ``0 -> Tombstone``, Internal/Node.elm:46-48) but not under a
+        tombstoned/dead prefix (a tombstone's children left the tree)."""
+        path = tuple(path)
+        if path and path[-1] == 0:
+            if len(path) == 1:
+                return TableNode(self, -1)
+            m = self._ensure_mirror()
+            s = m.get_slot(path[:-1])
+            if s is not None and s != 0 and not m.tomb[s]:
+                return TableNode(self, -1)
+            return None
+        slot = self._slot_at(path)
         return TableNode(self, slot) if slot is not None else None
 
     def parent(self, node: TableNode) -> Optional[TableNode]:
@@ -813,6 +836,10 @@ class TpuTree:
         node._check()
         if node.is_root:
             return None
+        if node._slot < 0:
+            # the shared sentinel's stored path is (), whose parent
+            # resolves to the root (CRDTree.elm:430-444 via empty path)
+            return TableNode(self, 0)
         return TableNode(self, int(self._ensure_mirror().parent[node._slot]))
 
     def next(self, node: TableNode) -> Optional[TableNode]:
@@ -821,7 +848,7 @@ class TpuTree:
         chain left the tree."""
         node._check()
         m = self._ensure_mirror()
-        if node.is_root or m.is_dead(node._slot):
+        if node.is_root or node._slot < 0 or m.is_dead(node._slot):
             return None
         s = m.nxt[node._slot]
         while s != NIL and m.tomb[s]:
@@ -836,7 +863,7 @@ class TpuTree:
         ``find`` does not skip tombstone candidates)."""
         node._check()
         m = self._ensure_mirror()
-        if node.is_root or m.is_dead(node._slot):
+        if node.is_root or node._slot < 0 or m.is_dead(node._slot):
             return None
         p = m.prev_for(node._slot)
         return TableNode(self, p) if p is not None else None
@@ -873,8 +900,10 @@ class TpuTree:
         return self
 
     def set_cursor(self, path: Sequence[int]) -> "TpuTree":
+        """Reference setCursor validates with ``get`` (CRDTree.elm:551-558)
+        — sentinel paths under live nodes are therefore valid targets."""
         path = tuple(path)
-        if self._slot_at(path) is None:
+        if self.get(path) is None:
             raise NotFound(f"no node at {path!r}")
         self._cursor = path
         return self

@@ -399,3 +399,50 @@ def test_random_session_engine_oracle_lockstep():
             assert o_ok == e_ok, (i, p)
         assert tuple(o.cursor) == tuple(t.cursor), i
         assert o.visible_values() == t.visible_values(), i
+
+
+def test_sentinel_get_and_set_cursor_match_oracle():
+    """Every children dict is seeded with the branch-head sentinel
+    (``0 -> Tombstone``, Internal/Node.elm:46-48), ``get`` resolves it
+    (descendant/child, Internal/Node.elm:284-299) and ``setCursor``
+    validates with ``get`` (CRDTree.elm:551-558) — so trailing-0 paths
+    under live nodes are real, addressable targets: value None, deleted,
+    timestamp 0, the SHARED empty path, parent = root, no siblings.
+    Under a tombstoned/dead/missing prefix the sentinel left the tree
+    with its branch.  Regression: the engine answered None/NotFound for
+    every sentinel path."""
+    OFF = 9 * 2 ** 32
+    o = crdt.init(9).add("a").add("b")
+    t = engine.init(9).add("a").add("b")
+
+    so, st = o.get([OFF + 1, 0]), t.get([OFF + 1, 0])
+    assert so is not None and st is not None
+    assert (so.get_value(), so.is_deleted(), so.timestamp, tuple(so.path)) \
+        == (st.value, st.is_deleted, st.timestamp, tuple(st.path)) \
+        == (None, True, 0, ())
+    assert o.parent(so) is o.root and t.parent(st).is_root
+    assert o.next(so) is None is t.next(st)
+    assert o.prev(so) is None is t.prev(st)
+    assert st.children() == []
+
+    # root's own sentinel
+    assert o.get([0]) is not None and t.get([0]) is not None
+    o = o.set_cursor([0])
+    t.set_cursor([0])
+    assert tuple(o.cursor) == tuple(t.cursor) == (0,)
+
+    # valid target under a live node
+    o = o.set_cursor([OFF + 1, 0])
+    t.set_cursor([OFF + 1, 0])
+    assert tuple(o.cursor) == tuple(t.cursor) == (OFF + 1, 0)
+
+    # gone with its branch: tombstoned prefix, missing prefix, sentinel
+    # prefix
+    o2 = o.delete([OFF + 1])
+    t.delete([OFF + 1])
+    for bad in ([OFF + 1, 0], [999, 0], [0, 0]):
+        assert o2.get(bad) is None and t.get(bad) is None
+        with pytest.raises(crdt.NotFound):
+            o2.set_cursor(bad)
+        with pytest.raises(crdt.NotFound):
+            t.set_cursor(bad)
