@@ -57,20 +57,25 @@ def main():
             print(json.dumps({"error": "leg timed out (900 s)",
                               "pack_gather": bool(flag)}), flush=True)
             continue
-        # take the last stdout line that parses as JSON (banners/library
-        # prints must not masquerade as the result); otherwise record the
-        # stderr tail so the failure cause survives the grant window
+        # take the last stdout line that parses as a JSON OBJECT (banners,
+        # bare scalars, or 'null' lines must not masquerade as the
+        # result); a measured result survives even if the leg's teardown
+        # then exits non-zero — grant-window data is too scarce to drop
         result = None
         for line in reversed(r.stdout.strip().splitlines()):
             try:
-                result = json.loads(line)
-                break
+                cand = json.loads(line)
             except ValueError:
                 continue
-        if result is None or r.returncode != 0:
+            if isinstance(cand, dict):
+                result = cand
+                break
+        if result is None:
             result = {"error": (r.stderr or r.stdout)[-400:],
                       "returncode": r.returncode,
                       "pack_gather": bool(flag)}
+        elif r.returncode != 0:
+            result["returncode"] = r.returncode
         print(json.dumps(result), flush=True)
 
 
