@@ -76,6 +76,7 @@ def main():
                       "pack_gather": bool(flag)}
         elif r.returncode != 0:
             result["returncode"] = r.returncode
+            result["teardown_stderr"] = (r.stderr or "")[-400:]
         print(json.dumps(result), flush=True)
 
 

@@ -446,3 +446,82 @@ def test_sentinel_get_and_set_cursor_match_oracle():
             o2.set_cursor(bad)
         with pytest.raises(crdt.NotFound):
             t.set_cursor(bad)
+
+
+@pytest.mark.parametrize("seed", [0, 7, 13])
+def test_wide_op_mix_lockstep(seed):
+    """Randomized lockstep over the FULL local-edit surface — add,
+    add_branch, add_after at historical paths, move_cursor_up,
+    set_cursor at historical paths (sentinels included), delete,
+    interleaved remote applies — asserting cursor, visible values,
+    clock, and outcome (success vs error TYPE) at every step, and log
+    equality at the end.  The narrower mixes in this file each found a
+    real divergence (sentinel delete, sentinel set_cursor); this pins
+    the widened surface.  20 seeds were clean at authoring; three run
+    in CI for time."""
+    rng = random.Random(seed)
+    o = crdt.init(7)
+    e = engine.init(7)
+    paths = [[0]]
+    rts = 0
+
+    def outcome(f):
+        try:
+            return "ok", f()
+        except (crdt.OperationFailedError, crdt.InvalidPathError,
+                crdt.NotFound) as ex:
+            return type(ex).__name__, None
+
+    for i in range(250):
+        r = rng.random()
+        if r < 0.4:
+            o = o.add(f"v{i}")
+            e.add(f"v{i}")
+            paths.append(list(o.cursor))
+        elif r < 0.5 and len(o.cursor) < 11:
+            o = o.add_branch(f"b{i}")
+            e.add_branch(f"b{i}")
+            paths.append(list(o.cursor))
+        elif r < 0.6:
+            p = rng.choice(paths)
+            ro, o2 = outcome(lambda: o.add_after(p, f"aa{i}"))
+            re2, _ = outcome(lambda: e.add_after(p, f"aa{i}"))
+            assert ro == re2, (seed, i, p, ro, re2)
+            if o2 is not None:
+                o = o2
+                paths.append(list(o.cursor))
+        elif r < 0.68:
+            o = o.move_cursor_up()
+            e.move_cursor_up()
+        elif r < 0.78:
+            p = rng.choice(paths)
+            ro, o2 = outcome(lambda: o.set_cursor(p))
+            re2, _ = outcome(lambda: e.set_cursor(p))
+            assert ro == re2, (seed, i, p, ro, re2)
+            if o2 is not None:
+                o = o2
+        elif r < 0.88:
+            # remote replica 99 appends a chain at the root: first op
+            # anchors at the head sentinel, later ops after the previous
+            # remote node — every apply SUCCEEDS, pinning cursor
+            # stability and clock bookkeeping under interleaved remote
+            # traffic (path's last element is the ANCHOR timestamp)
+            rts += 1
+            anchor = 0 if rts == 1 else 99 * 2 ** 32 + rts - 1
+            op = Add(99 * 2 ** 32 + rts, (anchor,), f"r{rts}")
+            ro, o2 = outcome(lambda: o.apply(op))
+            re2, _ = outcome(lambda: e.apply(op))
+            assert ro == re2 == "ok", (seed, i, ro, re2)
+            o = o2
+        elif o.visible_values():
+            p = rng.choice(paths)
+            ro, o2 = outcome(lambda: o.delete(p))
+            re2, _ = outcome(lambda: e.delete(p))
+            assert ro == re2, (seed, i, p, ro, re2)
+            if o2 is not None:
+                o = o2
+        assert tuple(o.cursor) == tuple(e.cursor), (seed, i)
+        assert o.visible_values() == e.visible_values(), (seed, i)
+        assert o.timestamp == e.timestamp, (seed, i)
+    assert op_mod.to_list(o.operations_since(0)) == \
+        op_mod.to_list(e.operations_since(0)), seed
