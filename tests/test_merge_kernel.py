@@ -626,8 +626,14 @@ def test_pack_gather_layout_bit_identity(monkeypatch):
               "visible_order", "num_nodes", "num_visible", "status"]
 
     def tables():
-        return {h: view.to_host(merge.materialize(arrs, hints=h))
-                for h in ("exhaustive", "auto", "join")}
+        out = {h: view.to_host(merge.materialize(arrs, hints=h))
+               for h in ("exhaustive", "auto", "join")}
+        # the explicit shard schedule shares _node_cols_from_row/_finish:
+        # the flag must preserve its bit-identity contract there too
+        from crdt_graph_tpu import parallel
+        out["shard"] = parallel.shard_materialize(
+            arrs, parallel.make_mesh(8))
+        return out
 
     monkeypatch.delenv("GRAFT_PACK_GATHER", raising=False)
     jax.clear_caches()
