@@ -1038,18 +1038,32 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
     # ---- 10. Euler tour: enter(v) = token v, exit(v) = token M + v.
     # Successors form one chain per tree ending in the self-loop at
-    # exit(root); tokens of parked (invalid) slots self-loop in isolation so
-    # the run detector below ignores them.
+    # exit(root); tokens of parked (invalid) slots self-loop, and ADJACENT
+    # self-looping tokens merge into one terminal zero-weight run below.
+    #
+    # LEAF EXITS ARE SKIPPED: exit tokens carry zero weight and ranks are
+    # only ever read at enter tokens, so a leaf's enter jumps straight to
+    # what its exit would target and the orphaned exit self-loops.  Suffix
+    # weights along the chain are unchanged (the skipped token weighs 0);
+    # what changes is run CONTRACTION on leaf-heavy tours: every
+    # enter(leaf)→exit(leaf) alternation that ended a run disappears, so
+    # chains whose leaves sit on slot-adjacent boundaries contract into
+    # longer runs (descending-chains config 6: 694 → 562 ms CPU).  The
+    # comb (bench/workloads.comb_pairs) stays the deliberate worst case:
+    # it alternates SLOT halves (teeth upper, children lower), so its
+    # enter half fragments regardless of exits and still takes the
+    # full-width Wyllie fallback.
     T = 2 * M
     tok = jnp.arange(T, dtype=jnp.int32)
     in_tour = in_forest.at[ROOT].set(True)
+    up = jnp.where(order_parent == slot_ids, M + slot_ids, M + order_parent)
+    chain_next = jnp.where(sib_next >= 0, sib_next, up)
+    is_leaf = first_child < 0
     enter_succ = jnp.where(
         ~in_tour, slot_ids,
-        jnp.where(first_child >= 0, first_child, M + slot_ids))
-    up = jnp.where(order_parent == slot_ids, M + slot_ids, M + order_parent)
+        jnp.where(is_leaf, chain_next, first_child))
     exit_succ = jnp.where(
-        ~in_tour, M + slot_ids,
-        jnp.where(sib_next >= 0, sib_next, up))
+        ~in_tour | is_leaf, M + slot_ids, chain_next)
     succ = jnp.concatenate([enter_succ, exit_succ]).astype(jnp.int32)
     if probe is not None:
         acc = acc + _probe_sum(succ, sib_next, first_child)
@@ -1074,7 +1088,12 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # (weight at or after enter(v)) = weighted count strictly before v.
     fwd = succ[:-1] == tok[1:]          # token j links to j+1
     bwd = succ[1:] == tok[:-1]          # token j+1 links to j
-    same_run = fwd | bwd
+    # adjacent SELF-LOOPING tokens (parked slots, skipped leaf exits)
+    # merge into one zero-weight terminal run instead of one singleton
+    # run each — a comb's M orphaned leaf exits must not push n_runs
+    # past R_CAP and re-trigger the very fallback the skip removes
+    loop_ = succ == tok
+    same_run = fwd | bwd | (loop_[:-1] & loop_[1:])
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
     rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
     end_mask = jnp.concatenate([boundary[1:], jnp.ones(1, bool)])
@@ -1090,8 +1109,12 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # Token weights and their exclusive prefix sums.  Only ENTER tokens
     # (the first M) carry weight — exit tokens count nothing — so the
     # prefix sums run at M+1 width and any token index x reads as
-    # ``cse[min(x, M)]`` (runs never straddle the enter/exit boundary,
-    # and every exit-space token sits at the final prefix value).
+    # ``cse[min(x, M)]``.  No LINKED run straddles the enter/exit
+    # boundary (token M-1 is the parked NULL slot's enter, token M the
+    # terminal; neither links ±1); the one straddling run that CAN exist
+    # is the merged self-loop block across M-1/M, which is terminal and
+    # zero-weight — its window reads are clamped and then zeroed by
+    # ``run_terminal`` in _expand, so the clamp never mis-weights it.
     cse_doc = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
     cse_vis = jnp.concatenate(
@@ -1103,9 +1126,13 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         bounds, suffix weights), via the monotone gather over rid[:M]
         (ranks are read only at ENTER tokens; rid[:M] < M since rid
         climbs by ≤ 1 from 0).  Direction: a run is forward when its
-        start token links to start+1 (runs never straddle the
-        enter/exit boundary: token M-1 is the parked NULL slot's enter
-        and token M the terminal, neither links ±1)."""
+        start token links to start+1.  Linked runs never straddle the
+        enter/exit boundary (token M-1 is the parked NULL slot's enter,
+        token M the terminal, neither links ±1); merged SELF-LOOP blocks
+        may straddle it, but they are terminal and zero-weight by
+        construction — ``run_fwd`` is False for them (a self-loop never
+        links +1) and ``run_terminal`` zeroes their weights, so every
+        later change must preserve exactly that pair of facts."""
         w = run_s_w.shape[0]
         run_fwd = succ[jnp.minimum(run_s_w, T - 1)] == run_s_w + 1
         run_tail = jnp.where(run_fwd, run_e_w, run_s_w)

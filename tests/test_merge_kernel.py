@@ -632,7 +632,7 @@ def test_pack_gather_layout_bit_identity(monkeypatch):
         # the flag must preserve its bit-identity contract there too
         from crdt_graph_tpu import parallel
         out["shard"] = parallel.shard_materialize(
-            arrs, parallel.make_mesh(8))
+            arrs, parallel.make_mesh(n_ops=8))
         return out
 
     monkeypatch.delenv("GRAFT_PACK_GATHER", raising=False)
