@@ -22,7 +22,8 @@ reference JSON codec, so existing clients interoperate unchanged.
 """
 
 from .core.errors import (AlreadyApplied, CRDTError, InvalidPathError,
-                          NotFound, OperationFailedError)
+                          CheckpointError, NotFound,
+                          OperationFailedError)
 from .core.operation import Add, Batch, Delete, Operation
 from .core.tree import CRDTree, DONE, TAKE, init
 from .core import timestamp
@@ -31,6 +32,6 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Add", "AlreadyApplied", "Batch", "CRDTError", "CRDTree", "Delete",
-    "DONE", "InvalidPathError", "NotFound", "Operation",
+    "CheckpointError", "DONE", "InvalidPathError", "NotFound", "Operation",
     "OperationFailedError", "TAKE", "init", "timestamp",
 ]

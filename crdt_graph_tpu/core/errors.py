@@ -45,3 +45,16 @@ class OperationFailedError(CRDTError):
     def __init__(self, operation) -> None:
         super().__init__(f"operation failed: {operation!r}")
         self.operation = operation
+
+
+class CheckpointError(CRDTError):
+    """A checkpoint/snapshot byte stream could not be parsed.
+
+    ``restore_packed`` translates the zoo of low-level failures a corrupt
+    or truncated npz produces (BadZipFile, zlib.error, KeyError on a
+    missing member, struct/ValueError on malformed metadata, …) into this
+    one typed error so servers and bootstrap clients can answer "bad
+    snapshot" without matching on zipfile internals.  Payload corruption
+    inside intact zip members is caught by the per-member CRC; flipped
+    bits in zip padding that change nothing decode to the original tree.
+    """

@@ -1041,6 +1041,25 @@ class TpuTree:
         own id (from ``POST /replicas``) or every snapshot-bootstrapped
         client would mint the same timestamps and their concurrent edits
         would collide (first-arrival dedup absorbing one silently)."""
+        import struct
+        import zipfile
+        import zlib
+        from .core.errors import CheckpointError
+        try:
+            return TpuTree._restore_packed_impl(path, replica)
+        except (zipfile.BadZipFile, zlib.error, KeyError, IndexError,
+                ValueError, TypeError, AttributeError,
+                NotImplementedError, EOFError, struct.error) as e:
+            # one typed failure for the zoo a corrupt/truncated/
+            # hand-edited npz raises (TypeError/AttributeError cover
+            # CRC-valid members whose JSON fields hold the wrong types);
+            # genuine I/O errors (missing file) pass through
+            raise CheckpointError(
+                f"corrupt or unreadable checkpoint: "
+                f"{type(e).__name__}: {e}") from e
+
+    @staticmethod
+    def _restore_packed_impl(path, replica):
         import json
         from .codec import json_codec
         z = np.load(path)

@@ -525,3 +525,75 @@ def test_wide_op_mix_lockstep(seed):
         assert o.timestamp == e.timestamp, (seed, i)
     assert op_mod.to_list(o.operations_since(0)) == \
         op_mod.to_list(e.operations_since(0)), seed
+
+
+def test_corrupt_checkpoint_detected_or_harmless():
+    """Snapshot-bootstrap robustness: any truncation, bit flip, or
+    garbage splice of a packed checkpoint either raises the one typed
+    ``CheckpointError`` (no zipfile/zlib internals leak to callers) or —
+    when the flip lands in zip padding the per-member CRCs don't cover —
+    decodes to a tree EQUAL to the original.  Valid snapshots restore;
+    a missing file stays FileNotFoundError (caller mistake, not
+    corruption)."""
+    import io
+    import random
+
+    t = engine.init(3)
+    for i in range(40):
+        t.add(f"v{i}")
+    buf = io.BytesIO()
+    t.checkpoint_packed(buf)
+    data = buf.getvalue()
+    expected = t.visible_values()
+
+    rng = random.Random(7)
+    detected = harmless = 0
+    for trial in range(120):
+        b = bytearray(data)
+        mode = trial % 3
+        if mode == 0:
+            b = b[:rng.randrange(1, len(b))]
+        elif mode == 1:
+            j = rng.randrange(len(b))
+            b[j] ^= 1 << rng.randrange(8)
+        else:
+            j = rng.randrange(len(b))
+            b[j:j + 8] = bytes(rng.randrange(256) for _ in range(
+                min(8, len(b) - j)))
+        try:
+            t2 = engine.TpuTree.restore_packed(io.BytesIO(bytes(b)),
+                                               replica=9)
+            assert t2.visible_values() == expected, trial
+            harmless += 1
+        except crdt.CheckpointError:
+            detected += 1
+    assert detected + harmless == 120 and detected > 0
+
+    ok = engine.TpuTree.restore_packed(io.BytesIO(data), replica=9)
+    assert ok.visible_values() == expected
+    with pytest.raises(FileNotFoundError):
+        engine.TpuTree.restore_packed("/nonexistent/ckpt.npz")
+
+    # CRC-valid but hand-edited: meta fields holding the wrong JSON types
+    # must also resolve to CheckpointError, not leak TypeError
+    import json as json_mod
+    import zipfile
+
+    import numpy as np
+    src = zipfile.ZipFile(io.BytesIO(data))
+    meta = json_mod.loads(bytes(np.load(io.BytesIO(src.read("meta.npy")))
+                                .tobytes()).decode())
+    meta["cursor"] = 5
+    out = io.BytesIO()
+    with zipfile.ZipFile(out, "w") as zf:
+        for name in src.namelist():
+            if name == "meta.npy":
+                b = io.BytesIO()
+                np.save(b, np.frombuffer(
+                    json_mod.dumps(meta).encode(), dtype=np.uint8))
+                zf.writestr(name, b.getvalue())
+            else:
+                zf.writestr(name, src.read(name))
+    with pytest.raises(crdt.CheckpointError):
+        engine.TpuTree.restore_packed(io.BytesIO(out.getvalue()),
+                                      replica=9)
