@@ -33,9 +33,23 @@ class DecodeError(ValueError):
 
 def _int_field(v: Any) -> int:
     """Strict integer: the reference decoder (Decode.int) rejects floats,
-    booleans and strings rather than coercing them."""
+    booleans and strings rather than coercing them.  Timestamps and path
+    elements are further bounded to the wire's domain [0, 2^62) — see
+    the inline comment on the check."""
     if isinstance(v, bool) or not isinstance(v, int):
         raise DecodeError(f"expected integer, got {v!r}")
+    if not (0 <= v < (1 << 62)):
+        # the wire's timestamp/path domain is [0, 2^62) — the native
+        # parser's MAX_TS bound (fastcodec.cpp emit): the merge kernel's
+        # int32 bit-half sort keys assume ts < 2^62 (merge._split_ts),
+        # and a well-formed wire op carrying a larger timestamp would
+        # silently corrupt bulk merges while the host path absorbed it
+        # (a Python int past 2^63 even crashes the int64 columns with
+        # OverflowError).  Both ingest paths must reject IDENTICALLY or
+        # the same payload converges differently by body size.  The
+        # reference's constructive domain (ts = replicaId·2^32 + counter,
+        # CRDTree.elm:137; JS safe integers) sits far inside the bound.
+        raise DecodeError(f"integer out of range: {v!r}")
     return v
 
 

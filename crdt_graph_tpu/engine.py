@@ -1064,6 +1064,14 @@ class TpuTree:
         from .codec import json_codec
         z = np.load(path)
         meta = json.loads(bytes(z["meta"]).decode())
+        # an inflated num_ops in a CRC-valid hand-edited meta must not
+        # drive pad_arrays into an attacker-sized allocation (MemoryError
+        # escapes the CheckpointError translation by design — a genuine
+        # out-of-memory on a legitimate restore should surface as itself)
+        if not isinstance(meta.get("num_ops"), int) or                 not (0 <= meta["num_ops"] <= int(z["kind"].shape[0])):
+            raise ValueError(
+                f"meta num_ops {meta.get('num_ops')!r} inconsistent with "
+                f"column length {int(z['kind'].shape[0])}")
         # files hold exactly num_ops rows (older ones: full capacity);
         # re-pad to the jit bucket so restored trees share trace caches
         # with pack-produced batches

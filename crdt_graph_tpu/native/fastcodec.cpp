@@ -110,8 +110,14 @@ struct Parser {
     if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) {
       return fail("expected integer, got float");
     }
-    if (v > uint64_t(INT64_MAX)) return fail("integer overflow");
-    *out = neg ? -int64_t(v) : int64_t(v);
+    // The wire's integer domain is [0, MAX_TS): the merge kernel's int32
+    // bit-half sort keys assume ts < 2^62 (merge.py _split_ts), so a
+    // well-formed wire op past the bound would silently corrupt bulk
+    // merges while the host path absorbed it — both ingest paths reject
+    // at decode (json_codec._int_field matches).  Single source of
+    // truth for the domain; emit() no longer re-checks.
+    if (neg || v >= uint64_t(MAX_TS)) return fail("integer out of range");
+    *out = int64_t(v);
     return true;
   }
 
@@ -449,12 +455,8 @@ struct Parser {
       return fail("path depth " + std::to_string(path.size()) +
                   " exceeds max_depth " + std::to_string(D));
     }
-    for (int64_t e : path) {
-      if (e < 0 || e >= MAX_TS) return fail("path element out of range");
-    }
-    if (kind == 0 && (ts < 0 || ts >= MAX_TS)) {
-      return fail("timestamp out of range");
-    }
+    // ts and path elements were domain-checked at parse (int64_field:
+    // [0, MAX_TS)), so no per-element re-check here
     c->kind.push_back(kind);
     c->depth.push_back(int32_t(path.size()));
     int64_t last = path.empty() ? 0 : path.back();

@@ -90,3 +90,45 @@ def test_value_codec_hooks():
     text = json_codec.dumps(op, value_encoder=lambda v: json.dumps(v))
     back = json_codec.loads(text, value_decoder=lambda v: json.loads(v))
     assert back == op
+
+
+def test_wire_integer_domain_bounded_identically_to_native():
+    """Timestamps and path elements are bounded to [0, 2^62) at DECODE
+    in both ingest paths: the merge kernel's int32 bit-half sort keys
+    assume ts < 2^62 (merge._split_ts), so a well-formed wire op past
+    the bound would silently corrupt bulk merges while the host path
+    absorbed it (and a Python int past 2^63 crashes the int64 columns
+    with OverflowError) — and the two parsers must reject IDENTICALLY
+    or the same payload converges differently by body size.  Values are
+    NOT bounded (caller-defined payloads)."""
+    from crdt_graph_tpu import native
+
+    mod = native.load()
+    cases = [
+        (2 ** 62 - 1, True),
+        (2 ** 62, False),
+        (2 ** 63 - 1, False),
+        (2 ** 63, False),            # pre-fix: OverflowError deep inside
+        (10 ** 25, False),
+        (-1, False),                 # constructive domain is non-negative
+        (0, True),                   # the sentinel anchor
+    ]
+    for v, want_ok in cases:
+        text = '{"op":"add","ts":%d,"path":[%d],"val":1}' % (v, max(v, 0))
+        try:
+            json_codec.loads(text)
+            py_ok = True
+        except json_codec.DecodeError:
+            py_ok = False
+        assert py_ok == want_ok, (v, py_ok)
+        if mod is not None:
+            try:
+                mod.parse_pack(text.encode(), 16)
+                nat_ok = True
+            except ValueError:
+                nat_ok = False
+            assert nat_ok == want_ok, (v, nat_ok)
+    # huge VALUE payloads stay legal — only ts/path are domain-bounded
+    op = json_codec.loads('{"op":"add","ts":7,"path":[0],"val":%d}'
+                          % (10 ** 30))
+    assert op.value == 10 ** 30
