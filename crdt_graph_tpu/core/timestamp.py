@@ -25,6 +25,20 @@ def counter(timestamp: int) -> int:
     return timestamp % REPLICA_SHIFT
 
 
+MAX_REPLICA = 1 << 30
+
+
 def make(replica: int, count: int) -> int:
-    """Compose a timestamp from a replica id and a counter."""
+    """Compose a timestamp from a replica id and a counter.
+
+    Replica ids are bounded to [0, 2^30): the wire's integer domain is
+    [0, 2^62) (json_codec._int_field / fastcodec int64_field — the merge
+    kernel's int32 bit-half sort keys need ts < 2^62), so a larger id
+    would mint timestamps every peer rejects at decode — the bound is
+    enforced HERE, at the constructive source, so the failure surfaces
+    at init instead of as remote decode errors."""
+    if not (0 <= replica < MAX_REPLICA):
+        raise ValueError(
+            f"replica id {replica!r} outside [0, 2**30): timestamps "
+            f"would leave the wire's [0, 2**62) integer domain")
     return replica * REPLICA_SHIFT + count

@@ -1045,6 +1045,10 @@ class TpuTree:
         import zipfile
         import zlib
         from .core.errors import CheckpointError
+        if replica is not None:
+            # validate the CALLER's id before the corrupt-file
+            # translation below — a bad argument is not a bad snapshot
+            ts_mod.make(replica, 0)
         try:
             return TpuTree._restore_packed_impl(path, replica)
         except (zipfile.BadZipFile, zlib.error, KeyError, IndexError,

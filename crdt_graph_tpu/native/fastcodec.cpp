@@ -116,7 +116,11 @@ struct Parser {
     // merges while the host path absorbed it — both ingest paths reject
     // at decode (json_codec._int_field matches).  Single source of
     // truth for the domain; emit() no longer re-checks.
-    if (neg || v >= uint64_t(MAX_TS)) return fail("integer out of range");
+    // JSON "-0" parses to 0 on the Python path (json.loads), so it must
+    // here too; any other negative is out of the wire domain
+    if ((neg && v != 0) || v >= uint64_t(MAX_TS)) {
+      return fail("integer out of range");
+    }
     *out = int64_t(v);
     return true;
   }

@@ -24,11 +24,23 @@ JAX selects; pin CPU by scrubbing the env first, see tests/conftest.py)
 """
 import functools
 import json
+import os
 import sys
 
 sys.path.insert(0, "/root/repo")
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # CPU run: scrub the force-registered TPU plugin before any backend
+    # init — env alone is not enough under the axon sitecustomize, and a
+    # CPU-intended profile dialing the wedged tunnel becomes a SECOND
+    # client against the grant (the r4 deadlock footgun)
+    from crdt_graph_tpu.utils import hostenv
+    hostenv.scrub_tpu_env(1)
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 from crdt_graph_tpu.utils import compcache
 compcache.enable()

@@ -128,7 +128,18 @@ def test_wire_integer_domain_bounded_identically_to_native():
             except ValueError:
                 nat_ok = False
             assert nat_ok == want_ok, (v, nat_ok)
-    # huge VALUE payloads stay legal — only ts/path are domain-bounded
-    op = json_codec.loads('{"op":"add","ts":7,"path":[0],"val":%d}'
-                          % (10 ** 30))
+    # JSON "-0" parses to integer 0 on both paths (json.loads yields 0;
+    # the native parser special-cases the negative-zero token)
+    neg_zero = '{"op":"add","ts":-0,"path":[-0],"val":1}'
+    assert json_codec.loads(neg_zero).ts == 0
+    if mod is not None:
+        mod.parse_pack(neg_zero.encode(), 16)
+
+    # huge VALUE payloads stay legal on BOTH paths — only ts/path are
+    # domain-bounded (values ride a separate number grammar natively)
+    huge_val = '{"op":"add","ts":7,"path":[0],"val":%d}' % (10 ** 30)
+    op = json_codec.loads(huge_val)
     assert op.value == 10 ** 30
+    if mod is not None:
+        cols = mod.parse_pack(huge_val.encode(), 16)
+        assert cols["values"][0] == 10 ** 30

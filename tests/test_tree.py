@@ -285,3 +285,22 @@ def test_invalid_path_errors():
         init(0).add("a").apply(Add(7, (9, 0), "b"))
     with pytest.raises(OperationFailedError):
         init(0).delete([1])
+
+
+def test_replica_id_bounded_at_constructive_source():
+    """Replica ids are bounded to [0, 2^30) where timestamps are MINTED
+    (core/timestamp.make): a larger id would stamp timestamps outside
+    the wire's [0, 2^62) integer domain and every peer would reject the
+    replica's edits at decode — the failure must surface at init, not
+    as remote "malformed add" errors."""
+    import crdt_graph_tpu as crdt
+    from crdt_graph_tpu import engine as engine_mod
+    with pytest.raises(ValueError):
+        crdt.init(2 ** 30)
+    with pytest.raises(ValueError):
+        crdt.init(-1)
+    with pytest.raises(ValueError):
+        engine_mod.init(2 ** 31)
+    t = crdt.init(2 ** 30 - 1)           # the largest legal id works
+    t = t.add("x")
+    assert t.timestamp == (2 ** 30 - 1) * 2 ** 32 + 1
