@@ -509,7 +509,9 @@ def _materialize(ops: Dict[str, jax.Array],
     the kernel and drifted).  Cuts: 1 resolution | 2 frames+local
     validity | 3 cascade+cycles | 4 deletes+dead | 5 NSA+sibling
     sort+tour | 6 run contraction+Wyllie+expansion | 7 ranks+orders |
-    None full kernel."""
+    None full kernel.  Stage-5 SUB-cuts for adversarial attribution
+    (between 4 and 5, in code order): 41 NSA chase | 42 + lifting cond |
+    43 + sibling links."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -936,6 +938,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
     mptr, _ = lax.while_loop(nsv_cond, nsv_body, (mptr0, jnp.int32(0)))
     nsa_unresolved = (mptr >= 0) & (mptr > slot_ids)
+    if probe is not None:
+        if probe == 41:        # stage-5a: NSA chase only
+            return acc + _probe_sum(mptr, nsa_unresolved)
 
     def _nsa_lifting(mptr):
         # up[k][v] = 2^k-th anchor ancestor (ROOT-absorbing; ROOT's slot
@@ -964,6 +969,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
                     lambda m: m, mptr)
     star_parent = jnp.where(mptr >= 0, mptr, pslot)
     star_sentinel = mptr < 0
+    if probe is not None:
+        if probe == 42:        # stage-5b: + lifting cond
+            return acc + _probe_sum(star_parent, star_sentinel)
 
     # Sibling sort → Euler-tour successor pointers.  Children of p: child-
     # branch T* roots first (group 0), then same-branch T* children (group
@@ -1035,6 +1043,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # terminal below)
     sib_next = sib_next.at[ROOT].set(-1)
     first_child = first_child.at[NULL].set(-1)
+    if probe is not None:
+        if probe == 43:        # stage-5c: + sibling links
+            return acc + _probe_sum(sib_next, first_child)
 
     # ---- 10. Euler tour: enter(v) = token v, exit(v) = token M + v.
     # Successors form one chain per tree ending in the self-loop at

@@ -52,12 +52,15 @@ from crdt_graph_tpu.ops import merge as merge_mod
 
 
 def profile(n: int = 1_000_000, stages=None, repeats: int = 3,
-            log=lambda m: None) -> list:
-    """Stage-cut rows for the production 64-chain merge at ``n`` ops on
-    the current device.  Shared by the CPU driver below and the TPU
-    session's phase 7."""
+            log=lambda m: None, workload=None) -> list:
+    """Stage-cut rows for a merge workload on the current device — the
+    ONE timing driver shared by the CPU runs below and the TPU session's
+    phases 7 (chain headline) and 8 (config-6 sub-cuts), so on-chip and
+    CPU profiles cannot diverge.  ``workload`` defaults to the
+    production 64-chain headline at ``n`` ops; ``stages`` may include
+    the stage-5 sub-cuts 41/42/43 (ops/merge.py)."""
     stages = list(stages or range(1, 9))
-    host_ops = chain_workload(64, n)
+    host_ops = workload if workload is not None else chain_workload(64, n)
     no_deletes = merge_mod.host_no_deletes(host_ops["kind"])
     ops = jax.device_put(host_ops)
 

@@ -32,5 +32,8 @@ cd /root/repo
   echo "=== probe_stage12 $(date -u +%H:%M:%S) ==="
   timeout 900 python scripts/probe_stage12.py 1000000 \
     >> "$OUT/tpu_probe12.txt" 2>&1
+  echo "=== tpu_session 8 (config6 subcuts) $(date -u +%H:%M:%S) ==="
+  timeout 1500 python scripts/tpu_session.py 8 \
+    >> "$OUT/tpu_postfix.jsonl" 2>> "$OUT/tpu_postfix.err"
   echo "=== done $(date -u +%H:%M:%S) ==="
 } >> "$OUT/tpu_next_grant.log" 2>&1

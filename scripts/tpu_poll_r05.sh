@@ -8,7 +8,7 @@
 LOG=/tmp/tpu_poll_r05.log
 rm -f /tmp/tpu_ok
 # 120 probes x (60 s probe + 150 s sleep) = 7.0 h worst-case poll, plus
-# the exec'd batch's summed timeouts (8100 s = 2.25 h) = 9.25 h — inside
+# the exec'd batch's summed timeouts (9600 s = 2.67 h) = 9.7 h — inside
 # the ~10 h bound that keeps a stray client clear of the driver's
 # round-end bench window (r4 lesson: two clients deadlock the grant)
 for i in $(seq 1 120); do

@@ -169,6 +169,22 @@ def phase7():
     out({"phase": 7, "stage_profile": rows})
 
 
+def phase8():
+    """Adversarial attribution: sub-cut profile of config 6 (descending
+    chains), whose cost structure INVERTS between devices — on CPU the
+    +298 ms is the full-width sibling sort (cut 43), but on-chip 1M
+    sorts are ~6 ms device time (PRIMS_TPU_r05), so config 6's 2280 ms
+    (window 1) must sit elsewhere; cuts 4/41/42/43/5/6/7 attribute it.
+    Same shared driver as phase 7.  Expensive in compiles (~7 traces) —
+    run only in long windows."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import probe_stages
+    rows = probe_stages.profile(
+        stages=(4, 41, 42, 43, 5, 6, 7), log=log,
+        workload=workloads.descending_chains(4096, 1_000_000))
+    out({"phase": 8, "config6_subcuts": rows})
+
+
 if __name__ == "__main__":
     phases = [int(a) for a in sys.argv[1:]] or [1, 2, 3]
     fns = [globals()[f"phase{p}"] for p in phases]   # typos fail fast
