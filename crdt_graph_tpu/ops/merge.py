@@ -1036,9 +1036,45 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
                              mode="drop", unique_indices=True)
             return sib, fc
 
+        def br_single(_):
+            """ALL crowded rows share one (parent, group) key — the flat
+            concurrent-editor shape (every op a sibling under one
+            anchor: adversarial configs 6/7 put ~1M rows here) — so the
+            sorted order is analytically slot-DESCENDING and the links
+            build from the crowding compaction with no sort: sib_next
+            follows cpos-1 (the next smaller slot), first_child of the
+            one key is the largest slot (cpos = n_crowded-1)."""
+            idx_by_cpos = jnp.full(M, -1, jnp.int32).at[
+                jnp.where(crowded, cpos, M)].set(
+                    slot_ids, mode="drop", unique_indices=True)
+            nxt = jnp.where(
+                crowded & (cpos > 0),
+                idx_by_cpos[jnp.maximum(cpos - 1, 0)], -1)
+            sib = jnp.full(M, -1, jnp.int32).at[
+                jnp.where(crowded, slot_ids, M)].set(
+                    nxt, mode="drop", unique_indices=True)
+            head = idx_by_cpos[jnp.maximum(n_crowded - 1, 0)]
+            gkey = jnp.clip(jnp.max(jnp.where(crowded, skey, -1)),
+                            0, M - 1)
+            fc = jnp.full(M, -1, jnp.int32).at[gkey].set(head)
+            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
+            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
+                       ].set(jnp.where(single_v < M, single_v, -1),
+                             mode="drop", unique_indices=True)
+            return sib, fc
+
+        ckey = jnp.where(crowded, skey, IPOS)
+        cgrp = jnp.where(crowded, ggrp.astype(jnp.int32), IPOS)
+        one_group = (n_crowded > 0) & \
+            (jnp.min(ckey) == jnp.max(jnp.where(crowded, skey, -1))) & \
+            (jnp.min(cgrp) == jnp.max(jnp.where(
+                crowded, ggrp.astype(jnp.int32), -1)))
+
         sib_next, first_child = lax.cond(
-            n_crowded <= S_CAP, br_small,
-            lambda _: _sib_links(skey, ggrp, neg_slot), None)
+            one_group, br_single,
+            lambda _: lax.cond(
+                n_crowded <= S_CAP, br_small,
+                lambda __: _sib_links(skey, ggrp, neg_slot), None), None)
     # the root never sits in a sibling list (its exit token is the chain
     # terminal below)
     sib_next = sib_next.at[ROOT].set(-1)

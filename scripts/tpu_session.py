@@ -14,9 +14,11 @@ phase failure records an error line and later phases still run):
 6. S_CAP/R_CAP cap sweep on the adversarial configs
 7. per-stage profile via the in-kernel probe cuts (shared driver with
    scripts/probe_stages.py) — VERDICT r4 next-2's on-chip attribution
+8. config-6 (descending chains) stage-5 sub-cut attribution — same
+   shared driver; ~7 fresh traces, so schedule it only in long windows
 
-Recommended one-grant order: 0 1 2 7 3 4 5 6 (cheap liveness first,
-headline + profile before the long sweeps).
+Recommended one-grant order: 0 1 2 7 3 4 5 6 8 (cheap liveness first,
+headline + profile before the long sweeps; 8 last).
 
 Usage: python scripts/tpu_session.py [phases…]   (default: 1 2 3)
 """

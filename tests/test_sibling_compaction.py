@@ -55,3 +55,67 @@ def test_tombstone_heavy_crowded_small_branch():
             m.apply_delete(tuple(op.path))
     want = np.array([int(m.ts[s]) for s in m.iter_visible()], dtype=np.int64)
     assert got.shape == want.shape and np.array_equal(got, want)
+
+
+def test_single_group_shortcut_matches_sort(monkeypatch):
+    """The sort-free single-group branch (merge.py ``br_single``: all
+    crowded rows share one (parent, group) key, so sorted order is
+    analytically slot-descending) must be bit-identical to the full
+    sort it replaces — compared by forcing ``GRAFT_S_CAP >= M`` (the
+    branch-free ``_sib_links`` path) on the same batches.  Covers the
+    taking cases (flat concurrent sibling storm; sibling storm with
+    deletes) and refusing near-misses (two crowded parents, in one case
+    split across branch-children and root-level siblings), all pinned
+    against the host mirror."""
+    from crdt_graph_tpu.codec import packed as packed_mod
+    from crdt_graph_tpu.core.operation import Batch, Delete
+
+    def mirror_ts(raw):
+        m = HostTree(16)
+        for op in raw:
+            if isinstance(op, Add):
+                m.apply_add(op.ts, tuple(op.path), op.value)
+            else:
+                m.apply_delete(tuple(op.path))
+        return np.array([int(m.ts[s]) for s in m.iter_visible()],
+                        dtype=np.int64)
+
+    R = 2 ** 32
+    cases = {}
+    # 1: flat sibling storm — every op a root child, interleaved replicas
+    storm = [Add((r + 1) * R + k, (0,), f"v{r}.{k}")
+             for k in range(300) for r in range(4)]
+    cases["storm"] = storm
+    # 2: storm with deletes sprinkled in
+    dels = [Delete((2 * R + k,)) for k in range(0, 300, 7)]
+    cases["storm+deletes"] = storm + dels
+    # 3: near-miss — two crowded parents
+    two = [Add(1 * R + 1, (0,), "p1"), Add(1 * R + 2, (0,), "p2")]
+    two += [Add(2 * R + k, (1 * R + 1, 0), f"a{k}") for k in range(3, 40)]
+    two += [Add(3 * R + k, (1 * R + 2, 0), f"b{k}") for k in range(3, 40)]
+    cases["two-parents"] = two
+    # 4: near-miss — crowding split across the host's branch children
+    # and root-level siblings anchored at the host
+    mixed = [Add(1 * R + 1, (0,), "host")]
+    mixed += [Add(2 * R + k, (1 * R + 1, 0), f"c{k}") for k in range(2, 30)]
+    mixed += [Add(3 * R + k, (1 * R + 1,), f"s{k}") for k in range(2, 30)]
+    cases["mixed-groups"] = mixed
+
+    for name, raw in cases.items():
+        arrs = packed_mod.pack(Batch(tuple(raw))).arrays()
+        want = mirror_ts(raw)
+        # the batches pack to M << the default S_CAP, where the Python-
+        # level ``if S_CAP >= M`` short-circuits to the plain sort and
+        # the cond machinery never traces — force the compaction branch
+        # (S_CAP below M) so br_single/one_group actually execute
+        monkeypatch.setenv("GRAFT_S_CAP", "4")
+        jax.clear_caches()
+        got = _visible_ts(arrs)
+        assert np.array_equal(got, want), name
+        # force the sort-only construction and compare bit-for-bit
+        monkeypatch.setenv("GRAFT_S_CAP", str(10 ** 9))
+        jax.clear_caches()
+        got_sort = _visible_ts(arrs)
+        monkeypatch.delenv("GRAFT_S_CAP")
+        jax.clear_caches()
+        assert np.array_equal(got, got_sort), name
