@@ -26,6 +26,18 @@ from ..codec import packed as packed_mod
 from ..ops import merge
 from . import honest, workloads
 
+# Fingerprint composition version, emitted in every stats row so
+# cross-round/cross-mode comparisons can't silently mix compositions
+# (ADVICE r5).  v2 (r5+): order-check mode folds (doc_index, status,
+# gathered seq) while no-expected mode folds (doc_index, visible_order,
+# status, ts) — the two MODES are not comparable with each other, and
+# neither matches v1 (pre-r5 archives, e.g. SWEEP_CPU_r04.jsonl and
+# earlier, which always folded the no-expected composition).  A
+# fingerprint mismatch across rows with different ``fingerprint_v`` —
+# or with equal v but different check modes — is a composition
+# difference, not kernel divergence.
+FINGERPRINT_V = 2
+
 
 def _as_arrays(workload) -> Dict[str, np.ndarray]:
     if isinstance(workload, dict):
@@ -111,6 +123,9 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
     floor_ms = honest.overhead_floor_ms()
     out = {
         "n_ops": n,
+        # see FINGERPRINT_V: which summary-fingerprint composition this
+        # row's timed kernel folded (order-check vs no-expected differ)
+        "fingerprint_v": FINGERPRINT_V,
         "p50_ms": stats["p50_ms"],
         "ops_per_sec": round(n / p50_s, 1),
         "compile_ms": stats["warm_ms"],

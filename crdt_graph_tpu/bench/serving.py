@@ -1,0 +1,139 @@
+"""Concurrent-serving workload: the serving engine under mixed traffic.
+
+Unlike the kernel benches (runner.py: one merge, honest device timing),
+this measures the SERVING layer end to end, in process (no socket noise):
+W writer threads push randomized deltas to M documents through the
+scheduler while R reader threads hammer snapshot reads, and one
+bootstrap-size push lands mid-run to prove reads don't stall behind a
+big merge.  Reported: reader latency percentiles (the snapshot-isolation
+headline), commit latency, coalesce width, and scheduler span stats.
+
+Usage: ``python -m crdt_graph_tpu.bench.serving [docs] [seconds]``
+(defaults 4 docs, 5 s).  Emits one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import List
+
+from ..codec import json_codec
+from ..core.operation import Add, Batch
+from ..serve import ServingEngine
+
+OFFSET = 2**32
+
+
+def _delta(replica: int, counter: int, anchor: int, size: int) -> tuple:
+    """A causally valid chain delta: ``size`` adds from ``replica``
+    anchored at ``anchor`` (0 = document head)."""
+    ops = []
+    prev = anchor
+    for _ in range(size):
+        counter += 1
+        ts = replica * OFFSET + counter
+        ops.append(Add(ts, (prev,), counter % 997))
+        prev = ts
+    return Batch(tuple(ops)), counter, prev
+
+
+def run(n_docs: int = 4, seconds: float = 5.0, writers_per_doc: int = 4,
+        readers: int = 8, delta_size: int = 32,
+        bootstrap_ops: int = 100_000) -> dict:
+    engine = ServingEngine()
+    stop = threading.Event()
+    read_lat_ms: List[float] = []
+    lat_lock = threading.Lock()
+    errors: List[str] = []
+
+    doc_ids = [f"bench{i}" for i in range(n_docs)]
+    for d in doc_ids:
+        engine.get(d)
+
+    def writer(doc_id: str, replica: int):
+        counter = 0
+        anchor = 0
+        while not stop.is_set():
+            delta, counter, anchor = _delta(replica, counter, anchor,
+                                            delta_size)
+            try:
+                accepted, _ = engine.submit(doc_id,
+                                            json_codec.dumps(delta))
+                if not accepted:
+                    errors.append("rejected")
+            except Exception as e:          # noqa: BLE001 — bench report
+                errors.append(repr(e))
+                return
+
+    def reader():
+        i = 0
+        local: List[float] = []
+        while not stop.is_set():
+            doc = engine.get(doc_ids[i % n_docs], create=False)
+            i += 1
+            t0 = time.perf_counter()
+            snap = doc.snapshot_view()
+            _ = len(snap.values)
+            _ = snap.clock_wire()
+            local.append((time.perf_counter() - t0) * 1e3)
+            if i % 50 == 0:
+                time.sleep(0)               # yield
+        with lat_lock:
+            read_lat_ms.extend(local)
+
+    threads = [threading.Thread(target=writer, args=(d, 1 + w), daemon=True)
+               for d in doc_ids for w in range(writers_per_doc)]
+    threads += [threading.Thread(target=reader, daemon=True)
+                for _ in range(readers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    # mid-run bootstrap push: a big chain lands on doc 0 while readers run
+    big, _, _ = _delta(99, 0, 0, bootstrap_ops)
+    t0 = time.perf_counter()
+    engine.submit(doc_ids[0], json_codec.dumps(big))
+    bootstrap_s = time.perf_counter() - t0
+
+    while time.perf_counter() - t_start < seconds:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    wall_s = time.perf_counter() - t_start
+
+    read_lat_ms.sort()
+    n = len(read_lat_ms)
+    merged = sum(engine.get(d).ops_merged for d in doc_ids)
+    out = {
+        "bench": "serving",
+        "docs": n_docs,
+        "writers": n_docs * writers_per_doc,
+        "readers": readers,
+        "wall_s": round(wall_s, 2),
+        "ops_merged": merged,
+        "merge_ops_per_sec": round(merged / wall_s, 1),
+        "reads": n,
+        "read_p50_ms": round(read_lat_ms[n // 2], 4) if n else None,
+        "read_p99_ms": round(read_lat_ms[(99 * n) // 100], 4) if n else None,
+        "read_max_ms": round(read_lat_ms[-1], 4) if n else None,
+        "bootstrap_ops": bootstrap_ops,
+        "bootstrap_commit_s": round(bootstrap_s, 3),
+        "errors": errors[:5],
+        "scheduler": engine.scheduler_metrics(),
+        "doc0_metrics": engine.get(doc_ids[0]).metrics(),
+    }
+    engine.close()
+    return out
+
+
+def main(argv) -> None:
+    n_docs = int(argv[0]) if argv else 4
+    seconds = float(argv[1]) if len(argv) > 1 else 5.0
+    print(json.dumps(run(n_docs=n_docs, seconds=seconds)), flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1:])

@@ -466,6 +466,30 @@ def select_rows(p: PackedOps, idx: np.ndarray) -> PackedOps:
     return out
 
 
+def with_capacity(p: PackedOps, cap: int) -> PackedOps:
+    """``p``'s rows re-padded to capacity ``cap`` (≥ ``num_ops``) in a
+    new PackedOps; ``p`` is untouched.  Lets the serving scheduler align
+    several documents' candidate sets to ONE shared capacity before a
+    batched launch (parallel.mesh.stack_packed), so each document's
+    parked table stays row-consistent with its own columns.  Value table,
+    hint provenance, and the cached ts index carry over (the real rows —
+    everything an index or hint can reference — are unchanged)."""
+    if cap == p.capacity:
+        return p
+    if cap < p.num_ops:
+        raise ValueError(f"capacity {cap} below op count {p.num_ops}")
+    n = p.num_ops
+    cols = pad_arrays({k: v[:n] for k, v in p.arrays().items()}, cap)
+    return PackedOps(
+        kind=cols["kind"], ts=cols["ts"], parent_ts=cols["parent_ts"],
+        anchor_ts=cols["anchor_ts"], depth=cols["depth"],
+        paths=cols["paths"], value_ref=cols["value_ref"],
+        pos=cols["pos"], values=p.values, num_ops=n,
+        parent_pos=cols["parent_pos"], anchor_pos=cols["anchor_pos"],
+        target_pos=cols["target_pos"], ts_rank=cols["ts_rank"],
+        hints_vouched=p.hints_vouched, ts_index=p.ts_index)
+
+
 def concat(a: PackedOps, b: PackedOps) -> PackedOps:
     """Concatenate two packed batches (the semilattice union before a
     merge) — the two-part case of :func:`concat_many`.

@@ -291,6 +291,9 @@ class TpuTree:
         # bumped whenever mirror slots are reassigned (kernel merges);
         # TableNode captures it at construction so stale views fail loudly
         self._generation = 0
+        # per-leaf applied mask of the last successful apply — the serving
+        # scheduler's attribution channel for fused multi-client batches
+        self._last_applied_mask: Optional[np.ndarray] = None
 
     # -- identity / clocks (parity: CRDTree.elm:130-139, 337-350) ---------
 
@@ -314,6 +317,17 @@ class TpuTree:
     @property
     def last_operation(self) -> Operation:
         return self._last_operation
+
+    @property
+    def last_applied_mask(self) -> Optional[np.ndarray]:
+        """Boolean per-leaf mask over the last successful
+        ``apply``/``apply_packed`` batch, in submitted order: True where
+        the leaf APPLIED, False where it absorbed as a duplicate.  Lets
+        a caller that FUSED several independent deltas into one batch
+        (serve/scheduler.py) attribute applied counts back to each
+        delta's row span without materializing op objects.  None before
+        the first apply; undefined after a raising apply."""
+        return self._last_applied_mask
 
     @property
     def log_length(self) -> int:
@@ -405,6 +419,7 @@ class TpuTree:
         leaves = list(op_mod.iter_leaves(operation))
         if not leaves:
             self._last_operation = Batch(())
+            self._last_applied_mask = np.zeros(0, dtype=bool)
             return self
         if len(leaves) <= DELTA_THRESHOLD:
             applied = self._apply_host(leaves)
@@ -429,7 +444,8 @@ class TpuTree:
         m = self._ensure_mirror()
         sp = m.savepoint()
         applied: List[Operation] = []
-        for op in leaves:
+        mask = np.zeros(len(leaves), dtype=bool)
+        for i, op in enumerate(leaves):
             if isinstance(op, Add):
                 st = m.apply_add(op.ts, tuple(op.path), op.value)
             else:
@@ -442,7 +458,9 @@ class TpuTree:
                 raise InvalidPathError(f"invalid path in {op!r}")
             if st == APPLIED:
                 applied.append(op)
+                mask[i] = True
         self._record(applied)
+        self._last_applied_mask = mask
         if applied:
             self._stale_device()
         if self._batch_depth == 0:
@@ -497,16 +515,39 @@ class TpuTree:
     def apply_packed(self, pnew: PackedOps) -> "TpuTree":
         """Remote apply from already-packed columns (the ingest fast
         path's second half — see :meth:`apply_wire`)."""
-        n = pnew.num_ops
         # below the bulk kernel crossover, keep apply()'s exact
         # sequence-semantics routing (host path / host-first)
-        if n < max(4 * DELTA_THRESHOLD, len(self._log) // 8):
+        if not self.packed_route(pnew.num_ops):
             return self.apply(op_mod.from_list(packed_mod.unpack(pnew)))
-
-        p = packed_mod.concat(self._ensure_packed(), pnew)
+        p = self.prepare_packed(pnew)
         # device table; only the status column reads back here (table()
         # converts the rest lazily, off the serving path)
         table = merge_mod.materialize(p.arrays(), hints=_mode(p))
+        return self.finish_packed(pnew, p, table)
+
+    def packed_route(self, n: int) -> bool:
+        """True when a packed delta of ``n`` leaves takes the bulk kernel
+        (prepare/materialize/finish); False routes through :meth:`apply`'s
+        sequence-semantics object path.  Exposed so the serving scheduler
+        (serve/scheduler.py) can group same-round kernel launches across
+        documents into one batched materialization."""
+        return n >= max(4 * DELTA_THRESHOLD, len(self._log) // 8)
+
+    def prepare_packed(self, pnew: PackedOps) -> PackedOps:
+        """Stage 1 of the staged kernel apply: the candidate column set
+        (current log ∪ delta) whose materialization yields the new view.
+        Callers either materialize it themselves (possibly batched with
+        other documents — parallel.mesh.batched_materialize) and hand the
+        table to :meth:`finish_packed`, or just call :meth:`apply_packed`."""
+        return packed_mod.concat(self._ensure_packed(), pnew)
+
+    def finish_packed(self, pnew: PackedOps, p: PackedOps,
+                      table: NodeTable) -> "TpuTree":
+        """Stage 2 of the staged kernel apply: per-op status check, clock
+        bookkeeping, columnar log commit, and view parking for a table
+        materialized from :meth:`prepare_packed`'s candidate set.  Raises
+        exactly what :meth:`apply` raises, leaving the replica untouched."""
+        n = pnew.num_ops
         n0 = len(self._log)
         st = np.asarray(table.status)[n0:n0 + n]
         failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]
@@ -556,6 +597,56 @@ class TpuTree:
         self._timestamp += int(np.sum(
             (kind == packed_mod.KIND_ADD) &
             ((ts_col >> 32) == self._replica)))
+        self._last_applied_mask = np.asarray(st == APPLIED)
+        return self
+
+    def apply_packed_chunked(self, pnew: PackedOps,
+                             chunk_ops: int) -> "TpuTree":
+        """:meth:`apply_packed` with the kernel work split into row
+        chunks of at most ``chunk_ops`` leaves, so one bootstrap-size
+        push never holds the scheduler in a single giant launch (and
+        never compiles a giant jit bucket).  Atomicity is preserved: a
+        failing chunk rolls the log, clocks, and view back to the
+        pre-call state, then the whole batch is retried single-shot —
+        which also covers the one semantic gap (SET-semantics batches
+        whose later rows anchor earlier rows' dependants ACROSS a chunk
+        boundary would reject chunked but absorb single-shot).  On
+        success the converged state is bit-identical to the single-shot
+        apply (pinned by tests/test_serving.py): the log holds the same
+        rows in the same order, only split across more column segments.
+        """
+        n = pnew.num_ops
+        if n <= chunk_ops or not self.packed_route(n):
+            return self.apply_packed(pnew)
+        n0 = len(self._log)
+        saved = (self._timestamp, dict(self._replicas),
+                 self._last_operation)
+        masks: List[np.ndarray] = []
+        try:
+            for s in range(0, n, chunk_ops):
+                chunk = packed_mod.select_rows(
+                    pnew, np.arange(s, min(s + chunk_ops, n)))
+                self.apply_packed(chunk)
+                masks.append(self._last_applied_mask)
+        except (OperationFailedError, InvalidPathError):
+            # a chunk rejected: restore the pre-call state and decide
+            # with one single-shot apply — identical outcome (applied
+            # set or raised error) to the unchunked path
+            self._log.truncate(n0)
+            (self._timestamp, self._replicas,
+             self._last_operation) = saved
+            self._invalidate()
+            return self.apply_packed(pnew)
+        mask = np.concatenate(masks) if masks else np.zeros(0, bool)
+        applied = int(mask.sum())
+        if applied == n:
+            self._last_operation = PackedBatch(pnew)
+        elif applied:
+            self._last_operation = PackedBatch(
+                packed_mod.select_rows(pnew, np.nonzero(mask)[0]))
+        else:
+            self._last_operation = Batch(())
+        self._last_applied_mask = mask
         return self
 
     def _apply_kernel(self, leaves: List[Operation]) -> List[Operation]:
@@ -575,6 +666,7 @@ class TpuTree:
             raise InvalidPathError(f"invalid path in {leaves[k]!r}")
         applied = [op for op, s in zip(leaves, st) if s == APPLIED]
         self._commit(applied, len(leaves) == len(applied), p, table)
+        self._last_applied_mask = np.asarray(st == APPLIED)
         return applied
 
     def _record(self, applied: List[Operation]) -> None:
@@ -737,41 +829,21 @@ class TpuTree:
 
     def dumps_since_bytes(self, initial_timestamp: int) -> bytes:
         """Wire JSON bytes for ``operations_since`` without per-op
-        Python encode: the packed columns stream through the native
-        egress encoder (native/fastcodec.cpp ``encode_pack``) — the
-        fast path for the reference's full-state bootstrap contract
-        (``operationsSince 0`` replays the whole log,
-        CRDTree.elm:408-418), where recursive per-op encode costs
-        seconds at headline scale.  Byte-identical to
+        Python encode — :func:`packed_since_bytes` over the cached
+        packed log.  Byte-identical to
         ``json_codec.dumps(self.operations_since(ts))`` (pinned by the
-        differential suite in tests/test_native_codec.py); falls back
-        to exactly that when the native module is unavailable or a
-        value payload isn't native-encodable.  Returned as bytes so the
-        service can write the multi-megabyte bootstrap payload straight
-        to the socket with no str round trip."""
+        differential suite in tests/test_native_codec.py).  Returned as
+        bytes so the service can write the multi-megabyte bootstrap
+        payload straight to the socket with no str round trip.  Without
+        the native module, answers from the object log directly (no
+        packed export of a host-path log just to re-encode it)."""
         from . import native
         from .codec import json_codec
-        if native.available():
-            p = self._ensure_packed()
-            n = p.num_ops
-            if initial_timestamp == 0:
-                start = 0
-            else:
-                # op_mod.since semantics: suffix from the Add whose
-                # timestamp matches, inclusive; no match -> empty batch.
-                # The applied log holds each add timestamp at most once
-                # (duplicates absorb before reaching _log), so the cached
-                # first-occurrence index IS the since() terminator and a
-                # delta pull costs O(1) after the first build
-                start = p.index().get(initial_timestamp)
-                if start is None or start >= n:
-                    return b'{"op":"batch","ops":[]}'
-            try:
-                return native.encode_pack(p, start)
-            except ValueError:
-                pass  # non-JSON-native payload: take the Python path
-        return json_codec.dumps(
-            self.operations_since(initial_timestamp)).encode()
+        if not native.available():
+            return json_codec.dumps(
+                self.operations_since(initial_timestamp)).encode()
+        return packed_since_bytes(self._ensure_packed(),
+                                  initial_timestamp)
 
     def dumps_since(self, initial_timestamp: int) -> str:
         """:meth:`dumps_since_bytes` as text."""
@@ -802,6 +874,13 @@ class TpuTree:
             # re-pack of the whole history
             self._packed = self._log.to_packed(self._max_depth)
         return self._packed
+
+    def packed_state(self) -> PackedOps:
+        """The whole applied log as one packed column set (cached between
+        edits).  Callers must treat the result as IMMUTABLE — the serving
+        engine (serve/snapshot.py) publishes it into lock-free read
+        snapshots, so mutating it would corrupt concurrent readers."""
+        return self._ensure_packed()
 
     def visible_values(self) -> List[Any]:
         """Visible values in document order — the render path."""
@@ -1011,23 +1090,7 @@ class TpuTree:
                 meta["last_op_bare"] = not isinstance(lo, Batch)
             else:
                 meta["last_operation"] = json_codec.encode(lo)
-        f = path if hasattr(path, "write") else open(path, "wb")
-        n = p.num_ops       # capacity padding never hits the wire/disk:
-        try:                # restore re-pads to the jit bucket
-            (np.savez_compressed if compress else np.savez)(
-                f, kind=p.kind[:n], ts=p.ts[:n],
-                parent_ts=p.parent_ts[:n],
-                anchor_ts=p.anchor_ts[:n], depth=p.depth[:n],
-                paths=p.paths[:n], value_ref=p.value_ref[:n],
-                pos=p.pos[:n], parent_pos=p.parent_pos[:n],
-                anchor_pos=p.anchor_pos[:n], target_pos=p.target_pos[:n],
-                ts_rank=p.ts_rank[:n],
-                values=np.frombuffer(json.dumps(p.values).encode(),
-                                     np.uint8),
-                meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
-        finally:
-            if f is not path:
-                f.close()
+        write_packed_npz(path, p, meta, compress=compress)
 
     @staticmethod
     def restore_packed(path, replica: Optional[int] = None) -> "TpuTree":
@@ -1049,8 +1112,14 @@ class TpuTree:
             # validate the CALLER's id before the corrupt-file
             # translation below — a bad argument is not a bad snapshot
             ts_mod.make(replica, 0)
+        # the corrupt-file translation covers ONLY the load/meta-parse/
+        # column-extraction region (ADVICE r5): tree ASSEMBLY below runs
+        # outside it, so a genuine bug in the restore path surfaces as
+        # itself instead of masquerading as a corrupt checkpoint.  The
+        # typed meta validation in _load_packed_parts is what makes that
+        # split safe — assembly only consumes already-validated fields.
         try:
-            return TpuTree._restore_packed_impl(path, replica)
+            p, meta, last_op = TpuTree._load_packed_parts(path)
         except (zipfile.BadZipFile, zlib.error, KeyError, IndexError,
                 ValueError, TypeError, AttributeError,
                 NotImplementedError, EOFError, struct.error) as e:
@@ -1061,9 +1130,15 @@ class TpuTree:
             raise CheckpointError(
                 f"corrupt or unreadable checkpoint: "
                 f"{type(e).__name__}: {e}") from e
+        return TpuTree._assemble_restored(p, meta, last_op, replica)
 
     @staticmethod
-    def _restore_packed_impl(path, replica):
+    def _load_packed_parts(path):
+        """Load + parse + validate a packed checkpoint: everything whose
+        failure means "corrupt/truncated/hand-edited file".  Returns
+        ``(p, meta, last_op)`` with every meta field assembly touches
+        already type-checked, so :meth:`_assemble_restored` cannot raise
+        on file content."""
         import json
         from .codec import json_codec
         z = np.load(path)
@@ -1071,11 +1146,48 @@ class TpuTree:
         # an inflated num_ops in a CRC-valid hand-edited meta must not
         # drive pad_arrays into an attacker-sized allocation (MemoryError
         # escapes the CheckpointError translation by design — a genuine
-        # out-of-memory on a legitimate restore should surface as itself)
-        if not isinstance(meta.get("num_ops"), int) or                 not (0 <= meta["num_ops"] <= int(z["kind"].shape[0])):
+        # out-of-memory on a legitimate restore should surface as itself).
+        # isinstance alone admits bools (num_ops=true restored as 1 op):
+        # reject them explicitly (ADVICE r5).
+        n = meta.get("num_ops")
+        if not isinstance(n, int) or isinstance(n, bool) or \
+                not (0 <= n <= int(z["kind"].shape[0])):
             raise ValueError(
-                f"meta num_ops {meta.get('num_ops')!r} inconsistent with "
+                f"meta num_ops {n!r} inconsistent with "
                 f"column length {int(z['kind'].shape[0])}")
+
+        def _int(name, value):
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise ValueError(f"meta {name} {value!r} is not an integer")
+            return value
+
+        # validate every field assembly consumes (the translation above
+        # must keep covering wrong-typed hand-edits, per the corruption
+        # fuzz in tests/test_engine.py, even though assembly now runs
+        # outside it)
+        ts_mod.make(_int("replica", meta["replica"]), 0)
+        _int("timestamp", meta["timestamp"])
+        if _int("max_depth", meta["max_depth"]) < 1:
+            raise ValueError(f"meta max_depth {meta['max_depth']!r} < 1")
+        if not isinstance(meta["cursor"], list):
+            raise ValueError(f"meta cursor {meta['cursor']!r} not a list")
+        meta["cursor"] = [_int("cursor entry", c) for c in meta["cursor"]]
+        if not isinstance(meta["replicas"], dict):
+            raise ValueError("meta replicas is not a mapping")
+        meta["replicas"] = {int(k): _int("clock", v)
+                            for k, v in meta["replicas"].items()}
+        last_op = None
+        if "last_op_span" in meta:
+            span = meta["last_op_span"]
+            if not (isinstance(span, list) and len(span) == 2):
+                raise ValueError(f"meta last_op_span {span!r} malformed")
+            s, e = (_int("last_op_span", x) for x in span)
+            if not (0 <= s <= e <= n):
+                raise ValueError(f"meta last_op_span {span!r} outside "
+                                 f"the {n}-op log")
+        else:
+            last_op = json_codec.decode(meta["last_operation"])
+
         # files hold exactly num_ops rows (older ones: full capacity);
         # re-pad to the jit bucket so restored trees share trace caches
         # with pack-produced batches
@@ -1085,8 +1197,7 @@ class TpuTree:
         for k in ("parent_pos", "anchor_pos", "target_pos", "ts_rank"):
             if k in z.files:
                 cols[k] = z[k]
-        cols = packed_mod.pad_arrays(
-            cols, packed_mod._bucket(max(meta["num_ops"], 1)))
+        cols = packed_mod.pad_arrays(cols, packed_mod._bucket(max(n, 1)))
         p = PackedOps(
             kind=cols["kind"], ts=cols["ts"],
             parent_ts=cols["parent_ts"],
@@ -1094,7 +1205,7 @@ class TpuTree:
             paths=cols["paths"],
             value_ref=cols["value_ref"], pos=cols["pos"],
             values=json.loads(bytes(z["values"]).decode()),
-            num_ops=meta["num_ops"],
+            num_ops=n,
             # older checkpoints lack hint columns: pad_arrays/__post_init__
             # fill -1 and the kernel's join fallback keeps semantics
             parent_pos=cols.get("parent_pos"),
@@ -1115,6 +1226,20 @@ class TpuTree:
         # would route every later merge through the sort+join fallback
         if p.hints_vouched and not packed_mod.verify_hints(p):
             packed_mod.rebuild_hints(p)
+        if last_op is None and meta.get("last_op_bare"):
+            s, e = meta["last_op_span"]
+            if e - s == 1:
+                # materializing a row consumes the op columns (kind/
+                # value_ref/values), which only the file vouches for —
+                # so it belongs HERE, under the corrupt-file
+                # translation, not in assembly
+                last_op = packed_mod.unpack_rows(p, s, e)[0]
+        return p, meta, last_op
+
+    @staticmethod
+    def _assemble_restored(p, meta, last_op, replica):
+        """Build the tree from validated parts — outside the corrupt-
+        checkpoint exception translation (see :meth:`restore_packed`)."""
         rid = meta["replica"] if replica is None else replica
         tree = TpuTree(rid, max_depth=meta["max_depth"])
         # columnar restore: the loaded columns ARE the log; objects
@@ -1123,7 +1248,7 @@ class TpuTree:
         tree._log.extend_packed(p)
         tree._packed = p
         tree._cursor = tuple(meta["cursor"])
-        tree._replicas = {int(k): v for k, v in meta["replicas"].items()}
+        tree._replicas = dict(meta["replicas"])
         if rid == meta["replica"]:
             tree._timestamp = meta["timestamp"]
         else:
@@ -1133,16 +1258,72 @@ class TpuTree:
             # same served snapshot must not mint colliding timestamps
             tree._timestamp = max(ts_mod.make(rid, 0),
                                   tree._replicas.get(rid, 0))
-        if "last_op_span" in meta:
-            s, e = meta["last_op_span"]
-            if meta.get("last_op_bare") and e - s == 1:
-                tree._last_operation = tree._log[s]
-            else:
-                tree._last_operation = PackedBatch(p, s, e)
+        if last_op is not None:
+            tree._last_operation = last_op
         else:
-            tree._last_operation = json_codec.decode(
-                meta["last_operation"])
+            s, e = meta["last_op_span"]
+            tree._last_operation = PackedBatch(p, s, e)
         return tree
+
+
+def packed_since_bytes(p: PackedOps, initial_timestamp: int) -> bytes:
+    """Anti-entropy wire JSON (``GET /ops?since=``) straight off packed
+    columns: the suffix from the Add matching ``initial_timestamp``
+    (inclusive; 0 = full log; no match = empty batch — op_mod.since
+    semantics), streamed through the native egress encoder
+    (native/fastcodec.cpp ``encode_pack``) with a Python fallback for
+    non-native-encodable payloads.  Single source of truth shared by
+    the live tree (:meth:`TpuTree.dumps_since_bytes`) and the serving
+    engine's published snapshots (serve/snapshot.py) — the applied log
+    holds each add timestamp at most once, so the cached
+    first-occurrence index IS the since() terminator and a delta pull
+    costs O(1) after the first build."""
+    from . import native
+    from .codec import json_codec
+    n = p.num_ops
+    if initial_timestamp == 0:
+        start = 0
+    else:
+        start = p.index().get(initial_timestamp)
+        if start is None or start >= n:
+            return b'{"op":"batch","ops":[]}'
+    if native.available():
+        try:
+            return native.encode_pack(p, start)
+        except ValueError:
+            pass  # non-JSON-native payload: take the Python path
+    return json_codec.dumps(op_mod.from_list(
+        packed_mod.unpack_rows(p, start, n))).encode()
+
+
+def write_packed_npz(path, p: PackedOps, meta: dict,
+                     compress: bool = True) -> None:
+    """Write the packed-checkpoint npz wire/disk format: ``p``'s real
+    rows (capacity padding never hits the wire — restore re-pads to the
+    jit bucket) plus a JSON ``meta`` sidecar.  Single source of truth
+    for the format, shared by :meth:`TpuTree.checkpoint_packed` and the
+    serving engine's snapshot endpoint (serve/snapshot.py), which
+    builds its meta from a published immutable snapshot instead of a
+    live tree.  ``path`` may be a filesystem path or a binary
+    file-like."""
+    import json
+    f = path if hasattr(path, "write") else open(path, "wb")
+    n = p.num_ops
+    try:
+        (np.savez_compressed if compress else np.savez)(
+            f, kind=p.kind[:n], ts=p.ts[:n],
+            parent_ts=p.parent_ts[:n],
+            anchor_ts=p.anchor_ts[:n], depth=p.depth[:n],
+            paths=p.paths[:n], value_ref=p.value_ref[:n],
+            pos=p.pos[:n], parent_pos=p.parent_pos[:n],
+            anchor_pos=p.anchor_pos[:n], target_pos=p.target_pos[:n],
+            ts_rank=p.ts_rank[:n],
+            values=np.frombuffer(json.dumps(p.values).encode(),
+                                 np.uint8),
+            meta=np.frombuffer(json.dumps(meta).encode(), np.uint8))
+    finally:
+        if f is not path:
+            f.close()
 
 
 def init(replica: int, max_depth: int = DEFAULT_MAX_DEPTH) -> TpuTree:

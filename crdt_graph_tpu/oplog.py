@@ -168,6 +168,16 @@ class OpLog:
     def __len__(self) -> int:
         return self._len
 
+    @property
+    def num_segments(self) -> int:
+        """Segment count — the log-fragmentation signal the serving
+        metrics export (serve/): chunked merges and coalesced commits
+        append one column segment per launch, and ``to_packed``'s
+        re-export cost scales with the segment count, so a document
+        whose fragmentation keeps climbing is paying concat work on
+        every snapshot publish."""
+        return len(self._segs)
+
     def __bool__(self) -> bool:
         return self._len > 0
 

@@ -163,6 +163,21 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
         return run()
 
 
+def stack_aligned(batches: Sequence[PackedOps]
+                  ) -> "tuple[Dict[str, np.ndarray], list]":
+    """:func:`stack_packed` plus per-document capacity alignment: every
+    batch is first re-padded to the SHARED capacity (codec.packed
+    ``with_capacity``) and the aligned PackedOps are returned alongside
+    the stacked arrays.  The serving scheduler commits each document
+    against its slice of the batched table, so the per-document columns
+    it parks must have the same row capacity the table was materialized
+    at — stacking alone would leave them inconsistent."""
+    from ..codec.packed import with_capacity
+    shared = max(p.capacity for p in batches)
+    aligned = [with_capacity(p, shared) for p in batches]
+    return stack_packed(aligned), aligned
+
+
 def stack_packed(batches: Sequence[PackedOps]) -> Dict[str, np.ndarray]:
     """Stack per-document packed ops into ``[B, N]`` arrays (N = max,
     pad-extended; path planes widened to the widest depth bucket) for

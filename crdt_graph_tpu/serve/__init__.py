@@ -1,0 +1,14 @@
+"""Serving engine: snapshot-isolated reads + coalescing merge scheduler.
+
+The layer between the HTTP handlers (service/http.py) and the TPU engine
+(engine.py) — see docs/SERVING.md for the design and the consistency /
+backpressure contracts.
+"""
+from .engine import (ECHO_LIMIT, ServedDoc, ServingEngine)
+from .queue import QueueFull, SchedulerError, SchedulerStopped
+from .scheduler import MergeScheduler
+from .snapshot import DocSnapshot
+
+__all__ = ["ECHO_LIMIT", "DocSnapshot", "MergeScheduler", "QueueFull",
+           "SchedulerError", "SchedulerStopped", "ServedDoc",
+           "ServingEngine"]

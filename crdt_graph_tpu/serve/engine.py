@@ -1,0 +1,309 @@
+"""The serving engine: documents, snapshot publication, write admission.
+
+``ServingEngine`` is a drop-in for ``service.store.DocumentStore`` (same
+duck-typed surface the HTTP handlers consume) with the concurrency model
+inverted, the same shape as a continuous-batching inference server:
+
+- **Reads never lock.**  Every read endpoint resolves against the
+  document's published :class:`~crdt_graph_tpu.serve.snapshot.DocSnapshot`
+  — an immutable value swapped in by the scheduler on commit.  A read
+  issued mid-merge sees the previous snapshot, complete and consistent.
+- **Writes queue.**  ``POST /ops`` bodies are parsed in the handler
+  thread (native column parse for bootstrap-size pushes), admitted into
+  the document's bounded queue (or refused with 429 + Retry-After), and
+  merged by the single scheduler thread, which fuses every delta pending
+  on a document into one kernel launch and batches independent documents
+  through one vmapped launch (parallel.mesh.batched_materialize).
+- **One thread owns JAX.**  All kernel work funnels through the
+  scheduler thread; handler threads never trace, compile, or launch.
+
+Consistency: coalesced deltas adopt the engine's large-batch SET
+semantics across (and within) deltas — any causally valid arrival order
+converges, duplicates absorb per-op, and a delta that genuinely fails
+(causality gap / invalid path) is re-tried sequentially so ONLY the
+guilty request gets the 409; innocent co-batched requests still commit.
+A write's ticket resolves only after its commit's snapshot is published,
+so every client reads its own writes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import engine as engine_mod
+from ..codec import json_codec
+from ..codec import packed as packed_mod
+from ..core import operation as op_mod
+from ..core.operation import Batch, Operation
+from ..oplog import PackedBatch
+from . import snapshot as snapshot_mod
+from .metrics import Counters, Histogram, LATENCY_BOUNDS_MS, WIDTH_BOUNDS
+from .queue import DocQueue, QueueFull, SchedulerStopped, WriteTicket
+
+SERVER_REPLICA = 0   # the server's own replica id; clients get 1, 2, …
+# (canonical: service.store re-imports it — both write paths must mint
+# the same identity scheme)
+
+# applied-ops echo cap, in leaves: at or under this the response carries
+# the applied ops; above it, the count only (re-encoding a bootstrap
+# push into its own response costs multiples of the merge itself).
+# Single source of truth — service/http.py imports it.
+ECHO_LIMIT = 4096
+
+# wire bodies at or above this many BYTES take the native column parse
+# (canonical; service.store.Document.WIRE_FAST_BYTES re-imports it so
+# the legacy and serving ingest routes share one crossover)
+WIRE_FAST_BYTES = 1 << 20
+
+# default kernel-launch chunk: a giant push merges as bounded row chunks
+# so no single launch (or jit bucket) is sized by the largest client
+DEFAULT_CHUNK_OPS = 1 << 17
+
+
+class ServedDoc:
+    """One served document: engine tree (scheduler-owned), write queue,
+    published snapshot, counters.  Read methods are Document-compatible
+    and resolve purely against the published snapshot."""
+
+    def __init__(self, doc_id: str, engine: "ServingEngine",
+                 max_depth: int):
+        self.doc_id = doc_id
+        self._engine = engine
+        self.tree = engine_mod.init(SERVER_REPLICA, max_depth=max_depth)
+        self.queue = DocQueue(max_requests=engine.max_queue_requests,
+                              max_leaves=engine.max_queue_leaves)
+        self.next_replica = 1
+        self._replica_lock = threading.Lock()
+        # CRDT counters (parity with service.store.Document)
+        self.ops_merged = 0
+        self.dup_absorbed = 0
+        self.batches_rejected = 0
+        # scheduler observability
+        self.admission_rejected = 0
+        self.commit_ms = Histogram(LATENCY_BOUNDS_MS)
+        self.coalesce_width = Histogram(WIDTH_BOUNDS)
+        self.chunks_launched = 0
+        self._seq = 0
+        self._snap = snapshot_mod.derive(doc_id, 0, self.tree)
+
+    # -- snapshot publication (scheduler thread only) ---------------------
+
+    def publish(self) -> None:
+        """Derive and swap in the next snapshot from the just-committed
+        tree.  Single writer (the scheduler), so ``seq`` is strictly
+        monotone; the attribute store is the linearization point."""
+        self._seq += 1
+        self._snap = snapshot_mod.derive(self.doc_id, self._seq, self.tree)
+
+    def snapshot_view(self) -> snapshot_mod.DocSnapshot:
+        """The current published snapshot (lock-free)."""
+        return self._snap
+
+    # -- Document-compatible read API (all lock-free) ---------------------
+
+    def snapshot(self) -> List:
+        return self._snap.visible_values()
+
+    def dumps_since_bytes(self, ts: int) -> bytes:
+        return self._snap.ops_since_bytes(ts)
+
+    def snapshot_packed(self) -> bytes:
+        return self._snap.checkpoint_bytes()
+
+    def clock(self) -> Dict[str, int]:
+        return self._snap.clock_wire()
+
+    def assign_replica(self) -> int:
+        with self._replica_lock:
+            rid = self.next_replica
+            self.next_replica += 1
+            return rid
+
+    def apply_body(self, body) -> Tuple[bool, Operation]:
+        """Document-compatible write entry: enqueue, await the commit.
+        Raises :class:`QueueFull` under backpressure (the handler's 429)
+        and decode errors immediately (400), exactly like the inline
+        path raised them."""
+        return self._engine.submit(self.doc_id, body)
+
+    def retry_after_s(self) -> int:
+        """Drain-time estimate for the Retry-After header, from this
+        document's own recent commit latency and queue depth."""
+        h = self.commit_ms.snapshot()
+        p50_ms = h.get("p50") or 50.0
+        est = (len(self.queue) + 1) * p50_ms / 1000.0
+        return max(1, min(30, int(est + 0.999)))
+
+    def metrics(self) -> Dict:
+        snap = self._snap
+        return {
+            "ops_merged": self.ops_merged,
+            "dup_absorbed": self.dup_absorbed,
+            "batches_rejected": self.batches_rejected,
+            "num_visible": len(snap.values),
+            "log_length": snap.log_length,
+            "replicas_assigned": self.next_replica - 1,
+            # scheduler observability (ISSUE: queue depth, coalesce
+            # width, chunk count, commit latency, snapshot age)
+            "queue_depth": len(self.queue),
+            "queue_leaves": self.queue.pending_leaves(),
+            "admission_rejected": self.admission_rejected,
+            "snapshot_seq": snap.seq,
+            "snapshot_age_s": round(snap.age_s(), 3),
+            "log_segments": snap.log_segments,
+            "chunks_launched": self.chunks_launched,
+            "commit_latency_ms": self.commit_ms.snapshot(),
+            "coalesce_width": self.coalesce_width.snapshot(),
+        }
+
+
+class ServingEngine:
+    """All documents hosted by this server, plus the merge scheduler.
+
+    DocumentStore-compatible (``get``/``ids``/``encode_ops``/
+    ``decode_ops``), so ``service.http.make_server`` serves either."""
+
+    def __init__(self, max_depth: int = 16, *,
+                 max_queue_requests: int = 256,
+                 max_queue_leaves: int = 4_000_000,
+                 chunk_ops: int = DEFAULT_CHUNK_OPS,
+                 cross_doc: bool = True,
+                 wire_fast_bytes: int = WIRE_FAST_BYTES,
+                 submit_timeout_s: float = 600.0,
+                 start: bool = True):
+        from .scheduler import MergeScheduler
+        self._docs: Dict[str, ServedDoc] = {}
+        self._lock = threading.Lock()
+        self._max_depth = max_depth
+        self.max_queue_requests = max_queue_requests
+        self.max_queue_leaves = max_queue_leaves
+        self.chunk_ops = chunk_ops
+        self.cross_doc = cross_doc
+        self.wire_fast_bytes = wire_fast_bytes
+        self.submit_timeout_s = submit_timeout_s
+        self.counters = Counters()
+        self.scheduler = MergeScheduler(self)
+        if start:
+            self.scheduler.start()
+
+    # -- store surface ----------------------------------------------------
+
+    def get(self, doc_id: str, create: bool = True) -> Optional[ServedDoc]:
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None and create:
+                doc = self._docs[doc_id] = ServedDoc(
+                    doc_id, self, self._max_depth)
+            return doc
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._docs)
+
+    def docs(self) -> List[ServedDoc]:
+        with self._lock:
+            return list(self._docs.values())
+
+    @staticmethod
+    def encode_ops(op: Operation) -> str:
+        return json_codec.dumps(op)
+
+    @staticmethod
+    def decode_ops(payload) -> Operation:
+        return json_codec.loads(payload)
+
+    # -- write path -------------------------------------------------------
+
+    def _parse(self, body) -> Tuple[packed_mod.PackedOps, int]:
+        """Wire body → packed delta (handler thread; decode errors
+        propagate to the caller's 400)."""
+        from .. import native
+        if isinstance(body, str):
+            body = body.encode()
+        if len(body) < self.wire_fast_bytes or not native.available():
+            leaves = list(op_mod.iter_leaves(json_codec.loads(body)))
+            return (packed_mod.pack(leaves, max_depth=self._max_depth),
+                    len(leaves))
+        p = native.parse_pack(body, max_depth=self._max_depth)
+        return p, p.num_ops
+
+    def submit(self, doc_id: str, body) -> Tuple[bool, Operation]:
+        """Parse, admit, and await the merge of one client delta.
+        Returns ``(accepted, applied_ops)`` like ``Document.apply_body``;
+        raises :class:`QueueFull` (→ 429) or :class:`SchedulerStopped`
+        (→ 503)."""
+        doc = self.get(doc_id)
+        # shed at the door BEFORE paying the parse: a saturated queue
+        # must not cost a full native parse (up to max_body) per
+        # rejected retry.  Racy pre-check only — the authoritative
+        # depth/leaves check is offer(), under the scheduler condition.
+        if len(doc.queue) >= doc.queue.max_requests:
+            doc.admission_rejected += 1
+            raise QueueFull(doc_id, len(doc.queue), doc.retry_after_s())
+        packed, n = self._parse(body)
+        ticket = WriteTicket(packed, n)
+        sched = self.scheduler
+        with sched.cond:
+            if sched.stopped:
+                raise SchedulerStopped("serving engine is shut down")
+            try:
+                doc.queue.offer(ticket, doc.retry_after_s(), doc_id)
+            except QueueFull:
+                doc.admission_rejected += 1
+                raise
+            sched.cond.notify_all()
+        ticket.wait(self.submit_timeout_s)
+        return ticket.accepted, ticket.applied_op
+
+    # -- ticket attribution (scheduler thread) ----------------------------
+
+    def finish_ticket(self, doc: ServedDoc, t: WriteTicket,
+                      mask: np.ndarray) -> None:
+        """Record one accepted ticket's outcome from its applied-leaf
+        mask (the engine's per-row attribution for fused batches)."""
+        applied = int(mask.sum())
+        t.accepted = True
+        t.applied_count = applied
+        doc.ops_merged += applied
+        doc.dup_absorbed += t.n_leaves - applied
+        if applied == 0:
+            t.applied_op = Batch(())
+            return
+        if applied == t.n_leaves:
+            sel = t.packed
+        else:
+            sel = packed_mod.select_rows(t.packed, np.nonzero(mask)[0])
+        if applied <= ECHO_LIMIT:
+            ops = packed_mod.unpack_rows(sel, 0, applied)
+            # single-leaf deltas echo the bare op (Document.apply parity)
+            t.applied_op = ops[0] if t.n_leaves == 1 else \
+                Batch(tuple(ops))
+        else:
+            # count-only consumers read num_leaves; nothing materializes
+            t.applied_op = PackedBatch(sel, 0, applied)
+
+    def reject_ticket(self, doc: ServedDoc, t: WriteTicket) -> None:
+        doc.batches_rejected += 1
+        t.accepted = False
+        t.applied_count = 0
+        t.applied_op = Batch(())
+
+    # -- lifecycle / observability ---------------------------------------
+
+    def scheduler_metrics(self) -> Dict:
+        """Engine-wide scheduler counters + profiling spans
+        (``GET /metrics/scheduler``)."""
+        from ..utils import profiling
+        out = dict(self.counters.snapshot())
+        out["docs"] = len(self._docs)
+        out["queue_depth_total"] = sum(
+            len(d.queue) for d in self.docs())
+        out["spans"] = profiling.span_stats("serve.")
+        return out
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the scheduler and fail any unresolved tickets (503) —
+        clean shutdown never leaves a handler thread blocked."""
+        self.scheduler.shutdown(timeout=timeout)

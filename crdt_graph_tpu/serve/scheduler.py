@@ -1,0 +1,343 @@
+"""The coalescing merge scheduler: one thread drains every document's
+write queue into fused kernel launches.
+
+Scheduling policy, per round:
+
+1. **Collect** — under the scheduler condition, drain each non-empty
+   document queue FIFO (one coalesced round per document; arrivals during
+   processing wait for the next round — no starvation, bounded latency).
+2. **Fuse** — concatenate each document's pending deltas into ONE packed
+   batch (``codec.packed.concat_many``: one allocation, hints
+   cross-resolved, vouched provenance preserved), remembering each
+   ticket's row span for per-request attribution.
+3. **Route** — fused batches above the engine's kernel crossover go to
+   the batched kernel; when ≥2 documents route to the kernel in the same
+   round (and each fits one chunk), their candidate sets are padded to a
+   shared capacity and materialized in ONE vmapped launch over a
+   ``docs``-sharded mesh (parallel.mesh.batched_materialize) — documents
+   are independent, so this scales linearly across chips.  Everything
+   else merges per-document, with giant pushes split into bounded chunks
+   (``engine.apply_packed_chunked``) so p50 commit latency is set by the
+   chunk size, not the largest client.
+4. **Attribute** — the engine's per-leaf applied mask, sliced by ticket
+   span, gives each request its applied count / dup count / echo without
+   materializing objects.  A fused batch that REJECTS (causality gap in
+   some delta) is retried sequentially per ticket so only the guilty
+   request 409s.
+5. **Publish, then resolve** — if anything applied, derive and swap the
+   document's read snapshot; only then are tickets resolved, so a
+   client's follow-up read sees its write.
+
+The scheduler thread is the only thread that touches live trees or JAX.
+Any non-CRDT exception while processing a document is recorded on that
+document's tickets (handlers answer 500) and counted — the scheduler
+itself stays up.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..codec import packed as packed_mod
+from ..core.errors import CRDTError
+from ..utils import profiling
+from .queue import SchedulerError, SchedulerStopped, WriteTicket
+
+# one work item: (doc, tickets, fused_batch_or_None, ticket_row_spans)
+_WorkItem = Tuple["ServedDoc", List[WriteTicket],
+                  Optional[packed_mod.PackedOps], List[Tuple[int, int]]]
+
+
+class MergeScheduler(threading.Thread):
+    """Single scheduler thread over a :class:`ServingEngine`'s queues."""
+
+    def __init__(self, engine, poll_s: float = 0.25):
+        super().__init__(name="crdt-merge-scheduler", daemon=True)
+        self.engine = engine
+        self.cond = threading.Condition()
+        self.poll_s = poll_s
+        self._stop_requested = False
+        self._paused = 0
+        self._meshes = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop_requested
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        with self.cond:
+            self._stop_requested = True
+            self.cond.notify_all()
+        if self.is_alive():
+            self.join(timeout)
+        # fail anything still queued (including tickets enqueued into a
+        # never-started scheduler) so no handler thread blocks forever
+        self._fail_pending(SchedulerStopped("serving engine shut down"))
+
+    def pause(self) -> None:
+        """Suspend draining (tests: stage a multi-doc round, then
+        :meth:`step` it deterministically)."""
+        with self.cond:
+            self._paused += 1
+
+    def resume(self) -> None:
+        with self.cond:
+            self._paused = max(0, self._paused - 1)
+            self.cond.notify_all()
+
+    # -- main loop --------------------------------------------------------
+
+    def run(self) -> None:
+        while True:
+            with self.cond:
+                while not self._stop_requested and \
+                        (self._paused or not self._has_work()):
+                    self.cond.wait(self.poll_s)
+                if self._stop_requested:
+                    break
+                drained = self._drain_locked()
+            if drained:
+                # a failure ANYWHERE in the round (fusion allocation,
+                # grouping logic) must resolve the already-drained
+                # tickets — they are in no queue, so nothing else can —
+                # and must not kill the scheduler thread
+                try:
+                    self._process(self._fuse_all(drained))
+                except Exception as e:  # noqa: BLE001 — thread boundary
+                    self.engine.counters.add("scheduler_errors")
+                    traceback.print_exc(file=sys.stderr)
+                    err = SchedulerError(f"merge round failed: {e!r}")
+                    err.__cause__ = e
+                    for _, tickets in drained:
+                        for t in tickets:
+                            if not t.done.is_set():
+                                t.error = err
+                                t.done.set()
+        self._fail_pending(SchedulerStopped("serving engine shut down"))
+
+    def step(self) -> int:
+        """Run exactly one scheduling round in the CALLING thread and
+        return the number of documents processed.  Only valid while the
+        scheduler thread is paused or not started (single-writer
+        invariant on the trees)."""
+        with self.cond:
+            drained = self._drain_locked()
+        if drained:
+            self._process(self._fuse_all(drained))
+        return len(drained)
+
+    def _has_work(self) -> bool:
+        return any(len(d.queue) for d in self.engine.docs())
+
+    def _fail_pending(self, err: BaseException) -> None:
+        with self.cond:
+            leftovers = [(d, d.queue.drain())
+                         for d in self.engine.docs() if len(d.queue)]
+        for _, tickets in leftovers:
+            for t in tickets:
+                t.error = err
+                t.done.set()
+
+    # -- one round --------------------------------------------------------
+
+    def _drain_locked(self) -> List[tuple]:
+        """Pop every pending queue FIFO (requires ``self.cond``).  Only
+        the O(1) deque drains happen under the condition — fusion's
+        column copying runs AFTER release, so writers' admission path
+        (offer or 429) never blocks behind a round's concatenation."""
+        return [(doc, doc.queue.drain())
+                for doc in self.engine.docs() if len(doc.queue)]
+
+    def _fuse_all(self, drained: List[tuple]) -> List[_WorkItem]:
+        """Fuse each document's drained deltas into one packed batch
+        (scheduler thread, no locks held)."""
+        work: List[_WorkItem] = []
+        for doc, tickets in drained:
+            doc.coalesce_width.observe(len(tickets))
+            spans: List[Tuple[int, int]] = []
+            parts = []
+            base = 0
+            for t in tickets:
+                spans.append((base, base + t.n_leaves))
+                base += t.n_leaves
+                if t.n_leaves:
+                    parts.append(t.packed)
+            with profiling.span("serve.fuse"):
+                fused = packed_mod.concat_many(parts) if parts else None
+            if len(parts) > 1:
+                self.engine.counters.add("fused_batches")
+                self.engine.counters.add("fused_tickets", len(tickets))
+            work.append((doc, tickets, fused, spans))
+        return work
+
+    def _process(self, work: List[_WorkItem]) -> None:
+        singles: List[_WorkItem] = []
+        groups: dict = {}
+        for item in work:
+            doc, tickets, fused, spans = item
+            if fused is None:      # only empty deltas this round
+                for t in tickets:
+                    self.engine.finish_ticket(doc, t,
+                                              np.zeros(0, dtype=bool))
+                    t.done.set()
+                continue
+            # cross-doc grouping wants one launch per round: batches
+            # that route to the kernel AND fit a single chunk — keyed by
+            # CANDIDATE (log ∪ delta) bucket so a big-log document never
+            # pads small co-grouped documents up to its own capacity
+            # (equal buckets = zero padding waste + shared vmap trace)
+            if (self.engine.cross_doc
+                    and doc.tree.packed_route(fused.num_ops)
+                    and fused.num_ops <= self.engine.chunk_ops):
+                cand = packed_mod._bucket(
+                    max(1, doc.tree.log_length + fused.num_ops))
+                groups.setdefault(cand, []).append(item)
+            else:
+                singles.append(item)
+        grouped_runs = []
+        for items in groups.values():
+            if len(items) >= 2:
+                grouped_runs.append(items)
+            else:
+                singles.extend(items)
+        for item in singles:
+            self._guarded(self._commit_single, item)
+        for items in grouped_runs:
+            self._process_grouped(items)
+
+    def _guarded(self, fn, item: _WorkItem, *args) -> None:
+        """Run one document's commit; a non-CRDT failure is recorded on
+        its tickets (handlers answer 500) — the scheduler survives."""
+        doc, tickets = item[0], item[1]
+        t0 = time.perf_counter()
+        try:
+            fn(item, *args)
+        except Exception as e:   # noqa: BLE001 — thread boundary: the
+            # error is re-raised in every waiting handler, not swallowed
+            self.engine.counters.add("scheduler_errors")
+            traceback.print_exc(file=sys.stderr)
+            err = SchedulerError(f"commit failed: {e!r}")
+            err.__cause__ = e
+            for t in tickets:
+                if not t.done.is_set():
+                    t.error = err
+                    t.done.set()
+            return
+        doc.commit_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def _commit_single(self, item: _WorkItem) -> None:
+        doc, tickets, fused, spans = item
+        n = fused.num_ops
+        try:
+            with profiling.span("serve.merge"):
+                doc.tree.apply_packed_chunked(fused, self.engine.chunk_ops)
+        except CRDTError:
+            self._sequential(doc, tickets)
+            return
+        doc.chunks_launched += max(1, -(-n // self.engine.chunk_ops))
+        self._attribute_and_publish(doc, tickets, spans,
+                                    doc.tree.last_applied_mask)
+
+    def _sequential(self, doc, tickets: List[WriteTicket]) -> None:
+        """Per-ticket fallback after a fused batch rejected: each delta
+        applies (or 409s) on its own, exactly like the unfused service —
+        only the guilty request fails."""
+        self.engine.counters.add("sequential_fallbacks")
+        any_applied = False
+        for t in tickets:
+            if t.n_leaves == 0:
+                self.engine.finish_ticket(doc, t, np.zeros(0, dtype=bool))
+                continue
+            try:
+                with profiling.span("serve.merge"):
+                    doc.tree.apply_packed_chunked(t.packed,
+                                                  self.engine.chunk_ops)
+            except CRDTError:
+                self.engine.reject_ticket(doc, t)
+            else:
+                mask = doc.tree.last_applied_mask
+                self.engine.finish_ticket(doc, t, mask)
+                any_applied = any_applied or bool(mask.any())
+        if any_applied:
+            with profiling.span("serve.publish"):
+                doc.publish()
+        for t in tickets:
+            t.done.set()
+
+    def _attribute_and_publish(self, doc, tickets, spans,
+                               mask: np.ndarray) -> None:
+        for t, (s, e) in zip(tickets, spans):
+            self.engine.finish_ticket(doc, t, mask[s:e])
+        if mask.any():
+            with profiling.span("serve.publish"):
+                doc.publish()
+        for t in tickets:
+            t.done.set()
+
+    # -- cross-document batched launch ------------------------------------
+
+    def _mesh_for(self, b: int):
+        """A cached ``(docs, 1)`` mesh whose docs axis is the largest
+        divisor of ``b`` that fits the device count (batched_materialize
+        requires the doc axis to divide the mesh axis)."""
+        import jax
+        from ..parallel import mesh as mesh_mod
+        ndev = len(jax.devices())
+        n_docs = max(d for d in range(1, min(b, ndev) + 1) if b % d == 0)
+        m = self._meshes.get(n_docs)
+        if m is None:
+            m = self._meshes[n_docs] = mesh_mod.make_mesh(n_docs, 1)
+        return m
+
+    def _process_grouped(self, grouped: List[_WorkItem]) -> None:
+        """≥2 documents' kernel merges in ONE vmapped launch: candidate
+        sets padded to a shared capacity (so each document's parked
+        table stays row-consistent with its own columns), stacked on a
+        leading doc axis, sharded over the mesh's ``docs`` axis.  Falls
+        back per-document only for CRDT rejections (sequential replay
+        attributes the guilty ticket); infrastructure failures surface
+        on the tickets via :meth:`_guarded`."""
+        import jax
+        from ..parallel import mesh as mesh_mod
+        try:
+            prepared = [doc.tree.prepare_packed(fused)
+                        for doc, _, fused, _ in grouped]
+            stacked, ps = mesh_mod.stack_aligned(prepared)
+            with profiling.span("serve.batched_launch"):
+                btab = mesh_mod.batched_materialize(
+                    stacked, self._mesh_for(len(grouped)))
+        except Exception as e:   # noqa: BLE001 — launch failed before any
+            # commit: every grouped document's tickets get the error
+            self.engine.counters.add("scheduler_errors")
+            traceback.print_exc(file=sys.stderr)
+            err = SchedulerError(f"batched launch failed: {e!r}")
+            err.__cause__ = e
+            for _, tickets, _, _ in grouped:
+                for t in tickets:
+                    t.error = err
+                    t.done.set()
+            return
+        self.engine.counters.add("cross_doc_batches")
+        self.engine.counters.add("cross_doc_docs", len(grouped))
+        for i, item in enumerate(grouped):
+            self._guarded(self._finish_grouped, item, ps[i],
+                          jax.tree.map(lambda a, i=i: a[i], btab))
+
+    def _finish_grouped(self, item: _WorkItem, p, table) -> None:
+        doc, tickets, fused, spans = item
+        doc.chunks_launched += 1
+        try:
+            with profiling.span("serve.merge"):
+                doc.tree.finish_packed(fused, p, table)
+        except CRDTError:
+            self._sequential(doc, tickets)
+            return
+        self._attribute_and_publish(doc, tickets, spans,
+                                    doc.tree.last_applied_mask)

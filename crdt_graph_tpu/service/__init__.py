@@ -1,5 +1,7 @@
 """Wire service: the server side of the reference's deployment model."""
+from ..serve import ServingEngine
 from .http import make_server, serve
 from .store import Document, DocumentStore
 
-__all__ = ["Document", "DocumentStore", "make_server", "serve"]
+__all__ = ["Document", "DocumentStore", "ServingEngine", "make_server",
+           "serve"]

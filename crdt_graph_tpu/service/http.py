@@ -23,23 +23,30 @@ companion text editor — interoperate unmodified):
   CRDTree.elm:637-639)
 - ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
 - ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
+- ``GET  /metrics/scheduler``          → serving-engine counters + spans
 
 Run: ``python -m crdt_graph_tpu.service [port]`` or embed via
 ``serve(port)`` / ``make_server(port)``.
 
-Concurrency design (VERDICT r3 weak-6): each document serializes behind
-one lock, held across the full kernel merge — reads of that document
-queue behind a large catch-up merge (hundreds of ms at million-op
-scale).  That is a deliberate proof-service trade: documents are
-independent (the store scales across docs, and the TPU engine batches
-merges per call), snapshot/ops reads are one lock-held array encode, and
-the client contract is pull-retry, not server-side queuing.  A
-production deployment would put reads on an immutable table snapshot
-(the engine's tables are persistent values — swap-on-merge) and bound
-merge latency by chunking giant batches; neither changes the wire
-contract.  ``POST /ops`` bodies are capped (``max_body``, default
+Concurrency design (serve/, docs/SERVING.md): reads and merges are
+decoupled by the serving engine.  Every read endpoint (doc values,
+``/ops?since=``, ``/clock``, ``/snapshot``, metrics) resolves against
+the document's PUBLISHED IMMUTABLE SNAPSHOT — swapped in atomically on
+each merge commit — so reads never take a merge lock and never stall
+behind a large catch-up merge.  ``POST /ops`` parses the body in the
+handler thread, enqueues the delta on the document's bounded merge
+queue, and blocks until the scheduler thread has fused it (with every
+other delta pending on that document, and with other documents' merges
+in one batched launch when they coincide) and published the commit's
+snapshot — so a client always reads its own writes.  Backpressure is
+explicit: a full queue answers ``429`` with a ``Retry-After`` estimate
+from the document's recent commit latency, without touching the tree;
+giant pushes merge as bounded chunks so they cannot monopolize the
+scheduler.  ``POST /ops`` bodies are capped (``max_body``, default
 128 MB ≈ a 2M-op JSON batch) and oversized requests get 413 without
-reading the body.
+reading the body.  Passing an explicit ``DocumentStore`` to
+``make_server`` keeps the legacy lock-per-document inline-merge path
+(same wire contract).
 """
 from __future__ import annotations
 
@@ -50,13 +57,17 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
+from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
+                     SchedulerStopped, ServingEngine)
 from .store import DocumentStore
 
 _DOC = re.compile(r"^/docs/([A-Za-z0-9_.-]+)(/.*)?$")
 
 
 DEFAULT_MAX_BODY = 128 << 20
-ECHO_LIMIT = 4096      # applied-ops echo cap (leaves); above: count only
+# ECHO_LIMIT (serve/engine.py): applied-ops echo cap in leaves; above it
+# the response carries the count only.  Imported, not redefined — the
+# scheduler stops materializing echo objects at the same bound.
 
 
 def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
@@ -66,14 +77,18 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
         def log_message(self, *args):   # quiet by default
             pass
 
-        def _send(self, code: int, payload) -> None:
-            self._send_raw(code, json.dumps(payload).encode())
+        def _send(self, code: int, payload, headers=None) -> None:
+            self._send_raw(code, json.dumps(payload).encode(),
+                           headers=headers)
 
         def _send_raw(self, code: int, body: bytes,
-                      ctype: str = "application/json") -> None:
+                      ctype: str = "application/json",
+                      headers=None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -106,6 +121,9 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 if sub == "/metrics":
                     self._send(200, {d: store.get(d).metrics()
                                      for d in store.ids()})
+                elif sub == "/metrics/scheduler" and \
+                        hasattr(store, "scheduler_metrics"):
+                    self._send(200, store.scheduler_metrics())
                 elif sub == "/docs":
                     self._send(200, {"docs": store.ids()})
                 else:
@@ -163,6 +181,23 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 return
             try:
                 accepted, applied = store.get(doc_id).apply_body(body)
+            except QueueFull as e:
+                # admission control: the merge queue is at capacity —
+                # shed the write at the door with the server's own
+                # drain-time estimate (serve/queue.py)
+                self._send(429, {"error": str(e),
+                                 "retry_after_s": e.retry_after_s},
+                           headers={"Retry-After": str(e.retry_after_s)})
+                return
+            except SchedulerStopped as e:
+                self._send(503, {"error": str(e)})
+                return
+            except SchedulerError as e:
+                # server-side merge failure: MUST answer 500, never a
+                # client-error class — this request was well-formed and
+                # retrying it later is legitimate
+                self._send(500, {"error": str(e)})
+                return
             except (DecodeError, json.JSONDecodeError, ValueError) as e:
                 # ValueError: the native parser's rejections (same
                 # malformed-input class as DecodeError)
@@ -183,12 +218,33 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
     return Handler
 
 
-def make_server(port: int = 0, store: Optional[DocumentStore] = None,
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that shuts an OWNED serving engine down with
+    the server — the scheduler thread stops and any in-flight write
+    tickets resolve (503) before ``server_close`` returns."""
+
+    owned_engine: Optional[ServingEngine] = None
+
+    def server_close(self):
+        super().server_close()
+        if self.owned_engine is not None:
+            self.owned_engine.close()
+
+
+def make_server(port: int = 0, store=None,
                 max_body: int = DEFAULT_MAX_BODY) -> ThreadingHTTPServer:
-    store = store or DocumentStore()
-    server = ThreadingHTTPServer(("127.0.0.1", port),
-                                 make_handler(store, max_body=max_body))
+    """Build the wire server.  ``store`` defaults to a fresh
+    :class:`~crdt_graph_tpu.serve.ServingEngine` (snapshot reads +
+    merge scheduler; closed with the server); pass a ``DocumentStore``
+    for the legacy inline-merge path or a pre-configured engine the
+    caller owns."""
+    owned = store is None
+    store = store if store is not None else ServingEngine()
+    server = ServingHTTPServer(("127.0.0.1", port),
+                               make_handler(store, max_body=max_body))
     server.store = store
+    if owned:
+        server.owned_engine = store
     return server
 
 

@@ -10,6 +10,17 @@ each document is one server replica that merges every client's deltas
 
 Observability counters (SURVEY §5 metrics row: ops merged, dedup hits,
 rejected batches) are served alongside.
+
+This is the LEGACY inline-merge store: one lock per document, held
+across the kernel merge — reads of a document queue behind its merges.
+The wire service now defaults to :class:`crdt_graph_tpu.serve.
+ServingEngine` (same duck-typed surface: ``get``/``ids``/``encode_ops``/
+``decode_ops``, documents exposing the read/write methods below), which
+serves reads from published immutable snapshots and coalesces writes
+through a merge scheduler (docs/SERVING.md).  ``Document`` remains the
+simple embeddable single-threaded/locked container, and its
+``apply``/``apply_body`` semantics are the reference behavior the
+scheduler's sequential fallback preserves per request.
 """
 from __future__ import annotations
 
@@ -21,8 +32,12 @@ from ..codec import json_codec
 from ..core import operation as op_mod
 from ..core.errors import CRDTError
 from ..core.operation import Operation
+# canonical definitions live with the serving engine (serve/engine.py);
+# both write paths MUST agree — the replica-id scheme and the ingest
+# crossover are wire-visible behavior, not per-store tuning
+from ..serve.engine import SERVER_REPLICA, WIRE_FAST_BYTES
 
-SERVER_REPLICA = 0   # the server's own replica id; clients get 1, 2, …
+__all__ = ["Document", "DocumentStore", "SERVER_REPLICA"]
 
 
 class Document:
@@ -54,9 +69,11 @@ class Document:
             return self._merge(lambda: self.tree.apply(operation),
                                len(leaves))
 
-    # wire bodies above this take the column ingest path (native parse,
-    # no per-op Python objects before the kernel)
-    WIRE_FAST_BYTES = 1 << 20
+    # wire bodies at/above this take the column ingest path (native
+    # parse, no per-op Python objects before the kernel) — shared with
+    # the serving engine's parse crossover (class attr so tests can
+    # monkeypatch the routing)
+    WIRE_FAST_BYTES = WIRE_FAST_BYTES
 
     def apply_body(self, body) -> Tuple[bool, Operation]:
         """Merge a raw wire body (``bytes`` as read off the socket, or

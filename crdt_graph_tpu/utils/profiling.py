@@ -13,10 +13,16 @@ the TPU framework owes timing + tracing around its merge path).
   prefix-staged readback timing in scripts/probe_stages.py there.
 - :func:`table_stats` — structural summary of a merged NodeTable
   (fan-out, depth, tombstone load) for capacity planning and debugging.
+- :func:`span` / :func:`span_stats` — named wall-clock spans aggregated
+  into a process-wide registry; the always-on production counterpart of
+  :func:`trace` used by the serving scheduler (serve/scheduler.py) to
+  attribute commit latency to its stages (parse, merge, publish) without
+  a profiler attached.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Callable, Dict
 
@@ -59,6 +65,51 @@ def timed(fn: Callable[..., Any], *args, repeats: int = 5,
         "warmup_ms": first * 1e3,
         "result": out,
     }
+
+
+_spans: Dict[str, Dict[str, float]] = {}
+_spans_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """``with span("serve.merge"): ...`` — accumulate the block's wall
+    time under ``name`` in the process-wide span registry (thread-safe;
+    the registry lock is held only for the counter update, never across
+    the timed block)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ms = (time.perf_counter() - t0) * 1e3
+        with _spans_lock:
+            s = _spans.get(name)
+            if s is None:
+                s = _spans[name] = {"count": 0, "total_ms": 0.0,
+                                    "max_ms": 0.0}
+            s["count"] += 1
+            s["total_ms"] += ms
+            s["max_ms"] = max(s["max_ms"], ms)
+
+
+def span_stats(prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """Snapshot of the span registry (names starting with ``prefix``),
+    with per-span mean derived from count/total."""
+    with _spans_lock:
+        out = {}
+        for name, s in _spans.items():
+            if name.startswith(prefix):
+                row = dict(s)
+                row["mean_ms"] = s["total_ms"] / max(s["count"], 1)
+                out[name] = row
+        return out
+
+
+def reset_spans(prefix: str = "") -> None:
+    """Drop accumulated spans (names starting with ``prefix``)."""
+    with _spans_lock:
+        for name in [n for n in _spans if n.startswith(prefix)]:
+            del _spans[name]
 
 
 @contextlib.contextmanager

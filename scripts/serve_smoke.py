@@ -1,0 +1,135 @@
+"""Serving-engine smoke: concurrent pushes + reads across documents over
+real HTTP, then convergence and clean-shutdown checks.
+
+The fast end-to-end gate for the scheduler (wired into tier-1 via
+tests/test_serve_smoke.py): W writers per document push causally valid
+deltas under distinct server-assigned replica ids while readers hammer
+every read endpoint; afterwards each document's ``/ops?since=0`` replay
+into a fresh engine must equal its served value sequence, the counters
+must account for every pushed op, and the server (plus its scheduler
+thread) must shut down cleanly.
+
+Run ad hoc: ``python scripts/serve_smoke.py [docs] [writers] [deltas]``
+"""
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
+        delta_size: int = 12) -> dict:
+    from http.client import HTTPConnection
+
+    from crdt_graph_tpu import engine as engine_mod
+    from crdt_graph_tpu.codec import json_codec
+    from crdt_graph_tpu.core.operation import Add, Batch
+    from crdt_graph_tpu.service import make_server
+
+    srv = make_server(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_port
+
+    def req(method, path, body=None):
+        conn = HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        raw = resp.read()
+        conn.close()
+        return resp.status, raw
+
+    doc_ids = [f"smoke{i}" for i in range(n_docs)]
+    errors = []
+    stop_readers = threading.Event()
+
+    def writer(doc_id):
+        st, raw = req("POST", f"/docs/{doc_id}/replicas")
+        if st != 200:
+            errors.append(f"replicas {st}")
+            return
+        rid = json.loads(raw)["replica"]
+        prev, counter = 0, 0
+        for _ in range(deltas):
+            ops = []
+            for _ in range(delta_size):
+                counter += 1
+                ts = rid * 2**32 + counter
+                ops.append(Add(ts, (prev,), counter))
+                prev = ts
+            st, raw = req("POST", f"/docs/{doc_id}/ops",
+                          json_codec.dumps(Batch(tuple(ops))))
+            out = json.loads(raw)
+            if st != 200 or not out.get("accepted") \
+                    or out.get("applied_count") != delta_size:
+                errors.append(f"push {st}: {out}")
+                return
+
+    def reader(doc_id):
+        while not stop_readers.is_set():
+            for sub in ("", "/ops?since=0", "/clock", "/metrics"):
+                st, _ = req("GET", f"/docs/{doc_id}{sub}")
+                if st != 200:
+                    errors.append(f"read {sub} -> {st}")
+                    return
+
+    writers = [threading.Thread(target=writer, args=(d,), daemon=True)
+               for d in doc_ids for _ in range(writers_per_doc)]
+    readers = [threading.Thread(target=reader, args=(d,), daemon=True)
+               for d in doc_ids]
+    for t in writers:
+        t.start()
+    for t in readers:
+        t.start()
+    for t in writers:
+        t.join(120)
+    stop_readers.set()
+    for t in readers:
+        t.join(30)
+    assert not errors, errors[:5]
+
+    # convergence: each doc's full op replay equals its served values
+    expected_ops = writers_per_doc * deltas * delta_size
+    summary = {}
+    for d in doc_ids:
+        st, raw = req("GET", f"/docs/{d}/ops?since=0")
+        assert st == 200
+        replica = engine_mod.init(0)
+        replica.apply(json_codec.loads(raw))
+        st, raw = req("GET", f"/docs/{d}")
+        served = json.loads(raw)["values"]
+        assert replica.visible_values() == served, f"{d} diverged"
+        assert len(served) == expected_ops, \
+            f"{d}: {len(served)} visible, want {expected_ops}"
+        st, raw = req("GET", f"/docs/{d}/metrics")
+        m = json.loads(raw)
+        assert m["ops_merged"] == expected_ops, m
+        summary[d] = {"visible": len(served),
+                      "coalesce_p50": m["coalesce_width"].get("p50")}
+
+    st, raw = req("GET", "/metrics/scheduler")
+    assert st == 200
+    summary["scheduler"] = json.loads(raw)
+
+    # clean shutdown: server AND scheduler thread stop
+    engine = srv.store
+    srv.shutdown()
+    srv.server_close()
+    assert not engine.scheduler.is_alive(), "scheduler survived shutdown"
+    assert engine.scheduler.stopped
+    return summary
+
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    out = run(*(int(a) for a in argv[:3]))
+    print(json.dumps(out), flush=True)
+    print("serve_smoke OK", file=sys.stderr)

@@ -44,6 +44,13 @@ from http.client import HTTPConnection as _HTTPConnection  # noqa: E402
 import pytest as _pytest               # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run ad hoc "
+        "for acceptance-scale workloads (e.g. the 1M-op serving soak)")
+
+
 @_pytest.fixture()
 def server():
     from crdt_graph_tpu.service import make_server

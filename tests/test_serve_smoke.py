@@ -1,0 +1,25 @@
+"""Tier-1 wiring for scripts/serve_smoke.py: the serving engine's
+end-to-end gate (concurrent pushes + reads across documents over real
+HTTP, convergence, clean shutdown) runs fast and unmarked so every
+tier-1 pass exercises the scheduler."""
+import importlib.util
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+_spec = importlib.util.spec_from_file_location(
+    "_serve_smoke",
+    os.path.join(os.path.dirname(__file__), "..", "scripts",
+                 "serve_smoke.py"))
+_serve_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_serve_smoke)
+
+
+def test_serve_smoke_end_to_end():
+    summary = _serve_smoke.run(n_docs=4, writers_per_doc=3, deltas=3,
+                               delta_size=8)
+    assert len([k for k in summary if k.startswith("smoke")]) == 4
+    assert summary["scheduler"]["queue_depth_total"] == 0
