@@ -43,6 +43,16 @@ TPU_ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "2"))
 TPU_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "600"))
 CPU_TIMEOUT_S = 900     # measured full CPU run ≈ 90 s
 BACKOFF_S = (15, 45)
+# Round-end wedge survival (VERDICT r5 next-2): grants correlate with
+# driver restarts and the round-end bench runs right after one, so the
+# bench POLLS the tunnel with short trivial-dispatch probes for at
+# least MIN_POLL_S before conceding, spending the driver's ~1800 s
+# budget instead of r5's 2×240 s.  The CPU fallback only runs once the
+# polling window is exhausted, and the full probe timeline is logged so
+# an honest CPU number is auditable as "the tunnel really was down".
+PROBE_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_PROBE_TIMEOUT", "60"))
+MIN_POLL_S = int(os.environ.get("GRAFT_BENCH_MIN_POLL", "900"))
+POLL_BUDGET_S = int(os.environ.get("GRAFT_BENCH_POLL_BUDGET", "1800"))
 
 
 def _warn_siblings() -> None:
@@ -179,6 +189,37 @@ def _run_child(env: dict, timeout_s: int) -> int:
         return -1
 
 
+def _prewarm() -> None:
+    """CPU-pinned child: trace + compile the production kernel into the
+    persistent compile cache while the parent polls the tunnel.  Warms
+    the CPU fallback's compile for sure (it shares this cache dir) and
+    the tunnel path wherever the axon cache key allows; either way the
+    work rides the polling window, which is otherwise dead time."""
+    import jax
+
+    from crdt_graph_tpu.utils import compcache
+    compcache.enable()
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_platforms", "cpu")
+    from crdt_graph_tpu.bench.runner import time_merge
+    from crdt_graph_tpu.bench.workloads import chain_expected_ts, \
+        chain_workload
+    t0 = time.perf_counter()
+    ops = chain_workload(N_REPLICAS, N_OPS)
+    time_merge(ops, repeats=1, audit=False,
+               expected_ts=chain_expected_ts(N_REPLICAS, N_OPS),
+               hints="exhaustive")
+    print(f"bench: prewarm compiled production trace in "
+          f"{time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def main() -> None:
     _warn_siblings()
     env = dict(os.environ)
@@ -189,30 +230,80 @@ def main() -> None:
         # a registered axon plugin would dial the tunnel from the child
         env.pop("PALLAS_AXON_POOL_IPS", None)
         sys.exit(_run_child(env, CPU_TIMEOUT_S))
-    alive = _tunnel_alive(env)
-    if not alive:
-        print("bench: retrying tunnel precheck once after 60s",
-              file=sys.stderr, flush=True)
-        time.sleep(60)
-        alive = _tunnel_alive(env)
-    attempts = TPU_ATTEMPTS if alive else 0
-    for attempt in range(attempts):
-        print(f"bench: attempt {attempt + 1}/{attempts} "
-              "(driver-selected backend)", file=sys.stderr, flush=True)
-        rc = _run_child(env, TPU_TIMEOUT_S)
-        if rc == 0:
-            return
-        if attempt < attempts - 1:
-            pause = BACKOFF_S[min(attempt, len(BACKOFF_S) - 1)]
-            print(f"bench: rc={rc}; backing off {pause}s before retry",
+
+    # pre-warm the persistent compile cache in a CPU-pinned sibling
+    # while the polling loop below owns the clock (it never touches the
+    # tunnel: the CPU env scrubs the plugin registration)
+    prewarm = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--prewarm"],
+        env=_cpu_env())
+
+    # poll the tunnel with short trivial-dispatch probes: a restart-
+    # adjacent grant can arrive minutes into the round-end window, and
+    # the old 2-probe precheck conceded exactly then.  Reserve room for
+    # the CPU fallback inside the driver's overall budget.
+    t0 = time.monotonic()
+    deadline = t0 + max(POLL_BUDGET_S - CPU_TIMEOUT_S // 2, MIN_POLL_S)
+    timeline = []
+    alive = False
+    rc = -1
+    attempt = 0
+    while True:
+        el = time.monotonic() - t0
+        probe_t0 = time.monotonic()
+        alive = _tunnel_alive(env, timeout_s=PROBE_TIMEOUT_S)
+        timeline.append({"t_s": round(el), "probe_s":
+                         round(time.monotonic() - probe_t0, 1),
+                         "alive": alive})
+        print(f"bench: probe @{el:.0f}s alive={alive} "
+              f"({len(timeline)} probes)", file=sys.stderr, flush=True)
+        if alive:
+            attempt += 1
+            print(f"bench: attempt {attempt} (driver-selected backend)",
                   file=sys.stderr, flush=True)
+            rc = _run_child(env, TPU_TIMEOUT_S)
+            if rc == 0:
+                print(f"bench: probe timeline {json.dumps(timeline)}",
+                      file=sys.stderr, flush=True)
+                if prewarm.poll() is None:
+                    prewarm.kill()
+                return
+            timeline.append({"t_s": round(time.monotonic() - t0),
+                             "attempt": attempt, "rc": rc})
+            if attempt >= TPU_ATTEMPTS and \
+                    time.monotonic() - t0 >= MIN_POLL_S:
+                break
+            # a fast-failing child must not relaunch back-to-back
+            # against the shared grant: back off before re-probing
+            pause = BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)]
+            print(f"bench: rc={rc}; backing off {pause}s before "
+                  "re-probing", file=sys.stderr, flush=True)
             time.sleep(pause)
-    print("bench: TPU attempts exhausted; falling back to CPU for an "
-          "honest (device-tagged) number", file=sys.stderr, flush=True)
-    cpu_env = dict(os.environ)
-    cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
-    cpu_env["JAX_PLATFORMS"] = "cpu"
-    rc = _run_child(cpu_env, CPU_TIMEOUT_S)
+        now = time.monotonic()
+        if now >= deadline and now - t0 >= MIN_POLL_S:
+            break
+        # pace to ~one probe per PROBE_TIMEOUT_S cycle: a fast-failing
+        # probe sleeps the remainder, a hung one already spent it
+        spent = time.monotonic() - probe_t0
+        if not alive and spent < PROBE_TIMEOUT_S:
+            time.sleep(min(PROBE_TIMEOUT_S - spent,
+                           max(deadline - time.monotonic(), 1)))
+
+    polled = time.monotonic() - t0
+    print(f"bench: tunnel never served a full run in {polled:.0f}s of "
+          f"polling ({len(timeline)} events); falling back to CPU for "
+          "an honest (device-tagged) number", file=sys.stderr, flush=True)
+    print(f"bench: probe timeline {json.dumps(timeline)}",
+          file=sys.stderr, flush=True)
+    # the timed CPU fallback must not share the host with a still-
+    # compiling prewarm sibling: give it a short grace to finish (its
+    # cache is exactly what the fallback wants warm), then kill it
+    try:
+        prewarm.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        prewarm.kill()
+        prewarm.wait()
+    rc = _run_child(_cpu_env(), CPU_TIMEOUT_S)
     sys.exit(rc)
 
 
@@ -221,5 +312,7 @@ if __name__ == "__main__":
         _child()
     elif "--precheck" in sys.argv:
         _precheck()
+    elif "--prewarm" in sys.argv:
+        _prewarm()
     else:
         main()

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..codec import packed as packed_mod
+from ..utils import jaxcompat
 from ..ops import merge
 from . import honest, workloads
 
@@ -85,7 +86,7 @@ def _summary_fn(no_deletes: bool = False, hints=None):
     jitted = jax.jit(fn)
 
     def wrapped(ops, *expected):
-        with jax.enable_x64(True):
+        with jaxcompat.enable_x64(True):
             return jitted(ops, *expected)
     return wrapped
 
@@ -109,7 +110,7 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
     # device_put must sit inside an x64 scope: outside it JAX silently
     # truncates the int64 timestamps to int32 (the mesh.py footgun) and
     # both the merge input and the expected sequence would be garbage
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         dev_ops = jax.device_put(ops)
         args = (dev_ops,) if expected_ts is None else \
             (dev_ops, jax.device_put(expected_ts))

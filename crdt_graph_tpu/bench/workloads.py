@@ -20,7 +20,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..codec.packed import compute_ts_rank
+from ..codec.packed import compute_ts_rank, derive_slot_hints
 from ..core.operation import Add, Delete, Operation
 
 OFFSET = 2**32
@@ -31,9 +31,12 @@ def _ts(rid: int, counter: int) -> int:
 
 
 def _with_rank(arrs):
-    """Attach the ingest rank hint (codec.packed docstring) to a raw
-    array workload, as every PackedOps producer does."""
+    """Attach the ingest rank hint (codec.packed docstring) and the
+    derived slot hints (codec.packed.derive_slot_hints) to a raw array
+    workload, as every PackedOps producer does — benches exercise the
+    same fused exhaustive trace the serving engine dispatches."""
     arrs["ts_rank"] = compute_ts_rank(arrs["kind"], arrs["ts"])
+    arrs.update(derive_slot_hints(arrs))
     return arrs
 
 

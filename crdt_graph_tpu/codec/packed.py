@@ -111,6 +111,9 @@ class PackedOps:
     # host-side ts -> first add position index, cached so engine concat
     # chains don't rebuild it per bulk apply (not a device field)
     ts_index: Optional[dict] = dataclasses.field(default=None, repr=False)
+    # lazily derived SLOT-hint columns (see derive_slot_hints); cached
+    # per object, invalidated by rebuild_hints (not a wire field)
+    slot_hints: Optional[dict] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         cap = self.capacity
@@ -132,14 +135,28 @@ class PackedOps:
         return int(self.paths.shape[1])
 
     def arrays(self) -> dict:
-        """The device-bound fields (everything but the value table)."""
-        return {
+        """The device-bound fields (everything but the value table).
+
+        Vouched batches additionally carry the derived SLOT-hint columns
+        (:func:`derive_slot_hints`): with them, the kernel's exhaustive
+        mode resolves every timestamp reference ELEMENTWISE — zero
+        M-wide resolution gathers on the production trace (the
+        chain-length budget, utils/chainaudit.py).  Unvouched batches
+        omit them (the kernel's verified auto mode could not trust them
+        anyway, and the extra host→device transfer would be dead
+        weight)."""
+        out = {
             "kind": self.kind, "ts": self.ts, "parent_ts": self.parent_ts,
             "anchor_ts": self.anchor_ts, "depth": self.depth,
             "paths": self.paths, "value_ref": self.value_ref, "pos": self.pos,
             "parent_pos": self.parent_pos, "anchor_pos": self.anchor_pos,
             "target_pos": self.target_pos, "ts_rank": self.ts_rank,
         }
+        if self.hints_vouched:
+            if self.slot_hints is None:
+                self.slot_hints = derive_slot_hints(out)
+            out.update(self.slot_hints)
+        return out
 
     def index(self) -> dict:
         """ts → first add batch position (built once, then cached).
@@ -168,6 +185,84 @@ def compute_ts_rank(kind: np.ndarray, ts: np.ndarray) -> np.ndarray:
         _, inv = np.unique(ts[add_rows], return_inverse=True)
         rank[add_rows] = inv.astype(np.int32)
     return rank
+
+
+def derive_slot_hints(arrs: dict) -> dict:
+    """Slot-level hint columns derived from the position hints + ranks —
+    the pack philosophy taken to its endpoint: the host already resolved
+    every timestamp reference to a batch POSITION and every add to a
+    RANK, so composing the two yields the exact values the kernel's
+    exhaustive-mode resolution would compute with its gathers
+    (merge._res_hint_impl, ``check_ts=False``), precomputed per op.
+    With these columns a vouched merge resolves references ELEMENTWISE:
+    the resolution-stage M-wide gathers (2 hint gathers + the
+    duplicate-election readback + the anchor-sibling slot gather)
+    leave the device trace entirely.
+
+    Derived, not wire, columns: every producer's ``arrays()`` computes
+    them lazily from the audited base columns, so no codec, checkpoint,
+    or native-parser change is needed and ``verify_hints`` keeps
+    auditing the single source of truth.  The encodings mirror the
+    kernel bit for bit (slot<<1 | found — the ``pf_pack``/``af_pack``
+    layout merge._finish already uses):
+
+    - ``parent_sl`` i32[N]: the parent reference's resolved slot+found.
+    - ``at_sl``     i32[N]: the fused anchor-or-target resolution
+      (anchor for Add rows, own/target ts for Delete rows).
+    - ``anchor_psl`` i32[N]: the anchor's OWN parent resolution (the
+      canonical anchor row's ``parent_sl``) — what the kernel's
+      sibling check read as ``pslot[aslot]``; NULL<<1 when the anchor
+      is unresolved/sentinel.
+    - ``dup_row``   i8[N]: 1 iff an earlier array row carries the same
+      add timestamp (the kernel's first-array-row-wins duplicate
+      election, formerly a win-frame readback gather).
+
+    Slot encodings depend on the array CAPACITY (NULL = cap+1): any
+    re-pad must recompute them (``pad_arrays`` does).
+    """
+    kind = arrs["kind"]
+    ts = arrs["ts"]
+    rank = arrs["ts_rank"]
+    n = int(kind.shape[0])
+    ROOT, NULL = 0, n + 1
+    is_add = kind == KIND_ADD
+    # mirror of the kernel's op_slot / _pack_slot_or_neg columns
+    has_rank = is_add & (ts > 0) & (ts < MAX_TS) & \
+        (rank >= 0) & (rank < n)
+    op_slot = np.where(has_rank, rank + 1, NULL).astype(np.int32)
+    son = np.where(is_add, op_slot, -1).astype(np.int32)
+
+    def _res(hint, want):
+        h = np.clip(hint, 0, n - 1)
+        sp = son[h]
+        ok = (hint >= 0) & (sp >= 0) & (want > 0) & (want < MAX_TS)
+        slot = np.where(want == 0, ROOT,
+                        np.where(ok, sp, NULL)).astype(np.int32)
+        found = (want == 0) | ok
+        return ((slot << 1) | found).astype(np.int32)
+
+    at_pos = np.where(is_add, arrs["anchor_pos"], arrs["target_pos"])
+    at_ts = np.where(is_add, arrs["anchor_ts"], ts)
+    parent_sl = _res(arrs["parent_pos"], arrs["parent_ts"])
+    at_sl = _res(at_pos, at_ts)
+    apos = arrs["anchor_pos"]
+    anchor_psl = np.where(
+        is_add & (apos >= 0), parent_sl[np.clip(apos, 0, n - 1)],
+        np.int32(NULL << 1)).astype(np.int32)
+    # first-array-row-wins duplicate flag (= the kernel's scatter-min
+    # winner election, which pack's first-add-per-ts dict also matches)
+    dup = np.zeros(n, np.int8)
+    rows = np.nonzero(has_rank)[0]
+    if rows.size:
+        first_of_rank = np.full(n + 1, n, np.int64)
+        # reversed so the SMALLEST row with each rank wins the store
+        first_of_rank[rank[rows][::-1]] = rows[::-1]
+        dup[rows] = (rows != first_of_rank[rank[rows]]).astype(np.int8)
+    return {"parent_sl": parent_sl, "at_sl": at_sl,
+            "anchor_psl": anchor_psl, "dup_row": dup}
+
+
+SLOT_HINT_COLS = ("parent_sl", "at_sl", "anchor_psl", "dup_row")
 
 
 def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
@@ -228,14 +323,22 @@ def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
 
 def pad_arrays(ops: dict, n: int) -> dict:
     """Pad a column dict's op axis to length ``n`` (pad rows are
-    KIND_PAD; hint columns -1; ``pos`` continues its arange)."""
+    KIND_PAD; hint columns -1; ``pos`` continues its arange).
+
+    Derived SLOT-hint columns encode NULL = capacity+1, so a capacity
+    change invalidates them; they are recomputed from the padded base
+    columns rather than padded (a stale NULL would alias a real slot
+    of the wider frame)."""
     cur = ops["kind"].shape[0]
     if cur == n:
         return dict(ops)
     if cur > n:
         raise ValueError(f"op count {cur} exceeds target {n}")
+    had_slot_hints = any(k in ops for k in SLOT_HINT_COLS)
     out = {}
     for k, v in ops.items():
+        if k in SLOT_HINT_COLS:
+            continue
         pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
         if k == "kind":
             out[k] = np.pad(v, pad_width, constant_values=KIND_PAD)
@@ -247,6 +350,8 @@ def pad_arrays(ops: dict, n: int) -> dict:
                 [v, np.arange(cur, n, dtype=v.dtype)])
         else:
             out[k] = np.pad(v, pad_width)
+    if had_slot_hints:
+        out.update(derive_slot_hints(out))
     return out
 
 
@@ -276,6 +381,7 @@ def rebuild_hints(p: PackedOps) -> None:
     p.anchor_pos = _lookup(p.anchor_ts, p.kind == KIND_ADD)
     p.target_pos = _lookup(p.ts, p.kind == KIND_DELETE)
     p.ts_index = None
+    p.slot_hints = None
     p.hints_vouched = True
 
 

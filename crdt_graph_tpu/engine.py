@@ -69,11 +69,29 @@ def _mode(p: PackedOps) -> Optional[str]:
     invariant fails loudly there instead of corrupting a merge."""
     if not p.hints_vouched:
         return None
-    if os.environ.get("GRAFT_DEBUG_VOUCH") and not packed_mod.verify_hints(p):
-        raise RuntimeError(
-            "hints_vouched batch failed the host hint audit — a producer "
-            "(pack/concat/parse_pack/restore) broke the vouch invariant; "
-            "the exhaustive kernel mode would silently mis-resolve")
+    if os.environ.get("GRAFT_DEBUG_VOUCH"):
+        if not packed_mod.verify_hints(p):
+            raise RuntimeError(
+                "hints_vouched batch failed the host hint audit — a "
+                "producer (pack/concat/parse_pack/restore) broke the "
+                "vouch invariant; the exhaustive kernel mode would "
+                "silently mis-resolve")
+        # the derived SLOT hints (the fused resolution's elementwise
+        # columns) must agree with a fresh derivation from the audited
+        # base columns: a stale cache (e.g. a producer mutating hint
+        # columns after arrays() ran) would mis-resolve the same way
+        if p.slot_hints is not None:
+            fresh = packed_mod.derive_slot_hints(
+                {k: getattr(p, k) for k in
+                 ("kind", "ts", "parent_ts", "anchor_ts", "parent_pos",
+                  "anchor_pos", "target_pos", "ts_rank")})
+            import numpy as _np
+            if any(not _np.array_equal(p.slot_hints[k], fresh[k])
+                   for k in fresh):
+                raise RuntimeError(
+                    "cached slot-hint columns diverge from the audited "
+                    "base columns — stale derivation cache; the fused "
+                    "exhaustive resolution would silently mis-resolve")
     return "exhaustive"
 
 

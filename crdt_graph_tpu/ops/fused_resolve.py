@@ -1,0 +1,162 @@
+"""Pallas TPU kernel: bounded-span multi-column row gather — the fused
+node-frame resolution sweep (VERDICT r5 next-1b).
+
+``out[t, :] = plane[idx[t], :]`` for an int64 plane whose indices are
+ARBITRARY per element but LOCALLY bounded: within each ``TILE`` of
+outputs the indices fall inside a ``SPAN``-row window.  This
+generalizes ops/mono_gather.py (which requires a nondecreasing index
+with increments ≤ 1) to the merge kernel's node-frame gather, whose
+index is the canonical-source-row column ``nsr``: near-diagonal
+whenever the batch arrives in (near-)timestamp order — the serving
+shape, and the config-5 headline exactly (replica-blocked generation
+makes rank order equal array order) — and arbitrary for shuffled
+deliveries, which take the fallback.
+
+Same scaffold as the validated mono_gather kernel: one bounded slice
+DMA'd HBM→VMEM per grid step with scalar-prefetched 128-aligned start
+offsets, and an EXACT one-hot MXU contraction.  Two generalizations:
+
+- the per-tile start is the tile's MINIMUM index (a cheap on-device
+  reshape-min), not ``rid[t0]``: in-tile offsets may land anywhere in
+  ``[0, SPAN)``, in any order;
+- int64 values travel as FOUR 16-bit limbs: every limb < 2^16 is
+  exactly representable in float32, so the one-hot matmul is exact for
+  the FULL int64 range and mono_gather's < 2^24 magnitude guard
+  disappears; limbs repack elementwise after the kernel.
+
+A tile whose indices straddle more than ``SPAN`` rows fails the
+on-device span check, and ``lax.cond`` selects the lax gather INSIDE
+the trace — fragmented batches cost the fallback's speed, never
+correctness.  ``_lax_rows`` is the reference semantics; CPU/interpret
+bit-identity (including the full merge) is pinned by
+tests/test_fused_resolve.py.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import jaxcompat
+
+TILE = 1024        # output rows per grid step
+SPAN = TILE + 128  # plane rows DMA'd per tile (starts floor to 128)
+MAX_LANES = 512    # widest limb plane worth staging through VMEM
+
+try:  # pallas is TPU/Mosaic; keep importable on bare CPU builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _lax_rows(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """Reference semantics: plain XLA row gather."""
+    return plane[idx]
+
+
+if HAVE_PALLAS:
+    def _kernel(starts_ref, idx_ref, plane_hbm, out_ref, scratch, sem):
+        i = pl.program_id(0)
+        # starts arrive pre-divided by 128: multiplying back inside the
+        # kernel lets Mosaic PROVE the dynamic DMA offset is aligned
+        # (an opaque prefetched scalar fails that proof) — the same
+        # trick as mono_gather, applied to the SUBLANE (row) dim
+        r0 = starts_ref[i] * 128
+        copy = pltpu.make_async_copy(
+            plane_hbm.at[pl.ds(r0, SPAN), :], scratch, sem)
+        copy.start()
+        copy.wait()
+        off = idx_ref[...] - r0            # [TILE] ∈ [0, SPAN)
+        onehot = (off[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (TILE, SPAN), 1)).astype(jnp.float32)
+        vals_f = scratch[...].astype(jnp.float32)          # [SPAN, C4]
+        # full-f32 MXU passes: every operand is a 16-bit limb < 2^16,
+        # products/sums stay below 2^24 — exact (mono_gather's guard
+        # bound, satisfied by construction here)
+        out = jax.lax.dot_general(
+            onehot, vals_f, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)           # [TILE, C4]
+        out_ref[...] = out.astype(jnp.int32)
+
+    def _pallas_call(limbs_pad, idx_pad, starts, c4, tiles, interpret):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(tiles,),
+            in_specs=[
+                # idx rides 1-D (TILE,) blocks — lane dim multiple of
+                # 128, matching XLA's s32[N] layout (mono_gather note)
+                pl.BlockSpec((TILE,), lambda i, starts: (i,)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((TILE, c4), lambda i, starts: (i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((SPAN, c4), jnp.int32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((tiles * TILE, c4), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, idx_pad, limbs_pad)
+
+
+def plane_rows(plane: jax.Array, idx: jax.Array,
+               use_pallas: bool | None = None,
+               interpret: bool = False) -> jax.Array:
+    """``plane[idx]`` for an i64 ``plane[R, C]`` and i32 ``idx[T]`` with
+    ``0 <= idx < R``.  ``use_pallas=None`` auto-selects: the Mosaic
+    kernel on TPU backends (with an in-trace span-check fallback to
+    lax), the lax gather elsewhere; falls back outright when the limb
+    plane would be too wide to stage through VMEM."""
+    r, c = plane.shape
+    t = idx.shape[0]
+    c4 = 4 * c
+    if use_pallas and os.environ.get("GRAFT_PALLAS_INTERPRET") == "1":
+        interpret = True
+    if use_pallas is None:
+        use_pallas = HAVE_PALLAS and not interpret and \
+            jax.default_backend() == "tpu" and \
+            os.environ.get("GRAFT_NO_PALLAS") != "1"
+    if not (use_pallas or interpret) or not HAVE_PALLAS or \
+            plane.dtype != jnp.int64 or c4 > MAX_LANES:
+        return _lax_rows(plane, idx)
+
+    tiles = -(-t // TILE)
+    t_pad = tiles * TILE
+    idx_pad = jnp.pad(idx.astype(jnp.int32), (0, t_pad - t), mode="edge")
+    by_tile = idx_pad.reshape(tiles, TILE)
+    starts = jnp.min(by_tile, axis=1) // 128
+    # every tile's window [128·start, 128·start + SPAN) must cover its
+    # indices; a violating tile routes the WHOLE gather to lax (one
+    # cond, not per-row patching — fragmented batches are wholesale
+    # fallback shapes, not mostly-local ones)
+    span_ok = jnp.all(jnp.max(by_tile, axis=1) - starts * 128 <
+                      jnp.int32(SPAN))
+
+    def _pallas(_):
+        # int64 → four 16-bit limbs per column (exact in f32); rows
+        # padded so the last tile's SPAN-window DMA stays in bounds
+        limbs = jnp.stack(
+            [((plane >> s) & 0xFFFF).astype(jnp.int32)
+             for s in (0, 16, 32, 48)], axis=-1).reshape(r, c4)
+        row_pad = SPAN + (-r) % 8
+        limbs_pad = jnp.pad(limbs, ((0, row_pad), (0, 0)))
+        # every operand is explicit i32; trace the call under x32 like
+        # mono_gather (x64 tracing emits grid ops Mosaic cannot
+        # legalize) — caller dtypes are unaffected
+        with jaxcompat.enable_x64(False):
+            out = _pallas_call(limbs_pad, idx_pad, starts, c4, tiles,
+                               interpret)
+        o = out[:t].astype(jnp.int64).reshape(t, c, 4)
+        return (o[:, :, 0] | (o[:, :, 1] << 16) |
+                (o[:, :, 2] << 32) | (o[:, :, 3] << 48))
+
+    return lax.cond(span_ok, _pallas, lambda _: _lax_rows(plane, idx),
+                    None)

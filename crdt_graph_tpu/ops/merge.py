@@ -62,6 +62,16 @@ bottleneck):
   downstream stages path-agnostic), so wrong hints cost speed, never
   correctness.  Slot ids compare like timestamps everywhere downstream;
   no int64 feeds a sort or a pointer loop.
+- **Fused resolution under the chain-length budget (round 6).**  For
+  vouched batches the host ALSO ships slot-level hints
+  (codec/packed.derive_slot_hints: rank-composed resolutions, the
+  anchor's parent slot, the duplicate flag), so the exhaustive trace
+  resolves every reference elementwise and the whole node frame rides
+  ONE multi-column plane row-gather (pallas bounded-span sweep on TPU,
+  ops/fused_resolve.py).  utils/chainaudit.py counts the production
+  trace's M-wide memory ops at trace time — ≤16 is CI-pinned
+  (tests/test_chain_audit.py) against the measured ~6 ms/op model
+  (docs/TPU_PROFILE.md §3-4, §6).
 - **Sorts only where contested.**  The one remaining sort — ordering
   sibling groups — runs at a small static width over just the rows whose
   parent has ≥ 2 children (count + prefix-sum compaction); chain-
@@ -123,6 +133,7 @@ import numpy as np
 from jax import lax
 
 from ..codec.packed import KIND_ADD, KIND_DELETE, MAX_TS
+from ..utils import jaxcompat
 from . import mono_gather
 
 # Per-op result statuses (sequential parity; see module docstring).
@@ -160,20 +171,25 @@ R_CAP_DEFAULT = 1 << 15   # run-pipeline compact width (merge._finish)
 
 
 def _pack_gather_on() -> bool:
-    """Trace-time flag GRAFT_PACK_GATHER: gathers that share an index
-    vector ride ONE multi-column plane row-gather instead of one gather
-    per column.  Every M-wide random gather costs ~6 ms of device time
-    at 1M on v5e regardless of payload width (scripts/probe_prims.py:
-    all single primitives sit at the tunnel-RTT floor; the while-loop
-    row isolates the per-gather cost), so IF row-gathers price like one
-    gather this removes ~4 of the ~10 memory ops in stages 1-2.  Whether
-    they do is exactly what prims rows 17-24 (stacked/planar layouts)
-    measure — default OFF until that A/B lands; bit-identity of the two
-    layouts is pinned by tests/test_merge_kernel.py either way.  Same
-    trace-time caveats as _env_cap (logged on every retrace)."""
+    """Trace-time flag GRAFT_PACK_GATHER: gathers (and compaction
+    scatters) that share an index vector ride ONE multi-column plane
+    row access instead of one pass per column.  Every M-wide random
+    gather costs ~6 ms of device time at 1M on v5e regardless of
+    payload width (scripts/probe_prims.py: all single primitives sit at
+    the tunnel-RTT floor; the while-loop row isolates the per-gather
+    cost), so row-plane packing removes most of stages 1-2's separate
+    memory ops — the chain-length budget (utils/chainaudit.py, pinned
+    ≤16 in CI) assumes it, and it is therefore DEFAULT ON as of round 6
+    (the cost model says plane rows price like one gather; prims rows
+    17-24 of the staged next-grant batch confirm it on chip, and
+    ``GRAFT_PACK_GATHER=0`` remains the one-command B leg of that A/B,
+    scripts/probe_packab.py).  Bit-identity of the two layouts is
+    pinned by tests/test_merge_kernel.py either way.  Same trace-time
+    caveats as _env_cap (logged on every retrace)."""
     import logging
     import os
-    on = os.environ.get("GRAFT_PACK_GATHER", "") not in ("", "0")
+    on = os.environ.get("GRAFT_PACK_GATHER", "1").lower() not in \
+        ("0", "off", "")
     logging.getLogger(__name__).info("trace-time GRAFT_PACK_GATHER=%d", on)
     return on
 
@@ -400,6 +416,16 @@ def _node_cols_from_row(node_row, src_ts, src_pos, M, ROOT, N):
     return is_node_slot, node_ts, node_pos
 
 
+def _plane_rows(plane: jax.Array, idx: jax.Array,
+                use_pallas) -> jax.Array:
+    """The node-frame plane row-gather (``plane[idx]``).  On TPU the
+    pallas bounded-span sweep (ops/fused_resolve.py) with its in-trace
+    lax fallback; the lax gather elsewhere — bit-identical either way
+    (tests/test_fused_resolve.py)."""
+    from . import fused_resolve
+    return fused_resolve.plane_rows(plane, idx, use_pallas=use_pallas)
+
+
 def _resolve_sorted(ops: Dict[str, jax.Array]):
     """The full SORTED+JOIN resolution: the 10-tuple interface from raw
     op columns, hint-free.  The whole-array kernel's fallback branch and
@@ -615,8 +641,39 @@ def _materialize(ops: Dict[str, jax.Array],
     have_link = hints != "join" and all(
         k in ops for k in ("parent_pos", "anchor_pos", "target_pos"))
     have_rank = have_link and "ts_rank" in ops
+    # SLOT hints (codec.packed.derive_slot_hints): the host composed the
+    # position hints with the ranks, so the vouched exhaustive mode
+    # resolves every reference ELEMENTWISE — no resolution gathers at
+    # all; the node-frame columns ride _finish's fused plane gather.
+    # Only meaningful under the vouched contract: the auto mode keeps
+    # the gather-based per-reference verification.
+    have_slot = hints == "exhaustive" and have_rank and all(
+        k in ops for k in ("parent_sl", "at_sl", "anchor_psl", "dup_row"))
 
-    if have_rank:
+    if have_slot:
+        rank = ops["ts_rank"].astype(jnp.int32)
+        is_real_add = is_add & (ts > 0) & (ts < BIG)
+        has_rank = is_real_add & (rank >= 0) & (rank < N)
+        op_slot_r = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
+        # duplicate election: host-precomputed first-array-row-wins flag
+        # (the win frame's readback gather leaves the trace; the
+        # scatter-min below stays — _finish still gathers the node frame
+        # through the winner row)
+        row_idx = jnp.arange(N, dtype=jnp.int32)
+        win = jnp.full(M, IPOS, jnp.int32).at[
+            jnp.where(has_rank, op_slot_r, M)].min(row_idx, mode="drop")
+        op_is_dup_r = ops["dup_row"].astype(bool) & has_rank
+        is_node_slot_r = win < jnp.int32(N)
+        pf = ops["parent_sl"].astype(jnp.int32)
+        af = ops["at_sl"].astype(jnp.int32)
+        # node_ts/node_pos = None: _finish derives them from its fused
+        # node-frame plane gather (one M-wide sweep instead of a
+        # separate stage-1 gather pair)
+        sel = (op_slot_r, op_is_dup_r, None, None,
+               is_node_slot_r, win,
+               pf >> 1, af >> 1,
+               (pf & 1).astype(bool), (af & 1).astype(bool))
+    elif have_rank:
         rank = ops["ts_rank"].astype(jnp.int32)
         is_real_add = is_add & (ts > 0) & (ts < BIG)
         has_rank = is_real_add & (rank >= 0) & (rank < N)
@@ -684,7 +741,8 @@ def _materialize(ops: Dict[str, jax.Array],
     else:
         sel = _sorted_ops(None)
 
-    acc = _probe_sum(*sel) if probe is not None else None
+    acc = _probe_sum(*(x for x in sel if x is not None)) \
+        if probe is not None else None
     if probe == 1:
         return acc
     return _finish(ops, sel, use_pallas, no_deletes, probe=probe,
@@ -699,7 +757,14 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     from ``_materialize`` so the explicitly partitioned resolve
     (parallel/shard.py) reuses the exact same downstream trace — bit
     identity across the whole-array and shard_map paths is structural,
-    not merely tested-in."""
+    not merely tested-in.
+
+    FUSED variant: a resolution built from host-derived slot hints
+    passes ``node_ts = node_pos = None`` (and ships ``anchor_psl`` in
+    ``ops``); both columns — plus the anchor-parent slot the sibling
+    check needs — are then derived from the one node-frame plane
+    row-gather below, so the entire frame construction is a single
+    M-wide sweep (the chain-length budget, utils/chainaudit.py)."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     anchor_ts = ops["anchor_ts"].astype(jnp.int64)
@@ -718,7 +783,11 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     is_del = kind == KIND_DELETE
     (op_slot, op_is_dup, node_ts, node_pos, is_node_slot, node_row,
      pp_slot, at_slot, pp_found, at_found) = sel
-
+    # FUSED node frame (slot-hint resolution, merge._materialize): the
+    # resolution stage shipped no node_ts/node_pos — they are derived
+    # below from the same plane gather as every other node column, so
+    # the whole node-frame construction is ONE M-wide sweep.
+    fused = node_ts is None
 
     # ---- 3. Node-table construction from the SELECTED assignment —
     # shared across all branches, outside any cond, and SCATTER-FREE:
@@ -737,16 +806,38 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # rows are Adds, so the gathered half sees anchors; d_tslot is read
     # at Delete rows only (step 7), where the fused column IS the target.
     pa = _pack_u((pp_slot << 1) | pp_found, (at_slot << 1) | at_found)
+    extra = []
+    if fused:
+        # hi = the anchor row's own parent resolution (what the sibling
+        # check read as pslot[aslot]); lo = batch position; plus the raw
+        # timestamp column — node_ts/node_pos/anchor-parent all ride the
+        # one plane row-gather instead of their own M-wide passes
+        ap_src = _pack_u(ops["anchor_psl"].astype(jnp.int32), pos)
+        extra = [ap_src[:, None], ts[:, None]]
     if _pack_gather_on():
-        # all three nsr-indexed gathers ride one [N, D+2] i64 plane row
+        # all nsr-indexed gathers ride one [N, D+2(+2)] i64 plane row
         plane = jnp.concatenate(
-            [dsv_src[:, None], pa[:, None], paths], axis=1)
-        g = plane[nsr]
-        dsv, pa_g, claimed_raw = g[:, 0], g[:, 1], g[:, 2:]
+            [dsv_src[:, None], pa[:, None]] + extra + [paths], axis=1)
+        g = _plane_rows(plane, nsr, use_pallas)
+        k = 2 + len(extra)
+        dsv, pa_g, claimed_raw = g[:, 0], g[:, 1], g[:, k:]
+        if fused:
+            ap_g, ts_g = g[:, 2], g[:, 3]
     else:
         dsv = dsv_src[nsr]
         pa_g = pa[nsr]
         claimed_raw = paths[nsr]
+        if fused:
+            ap_g, ts_g = ap_src[nsr], ts[nsr]
+    if fused:
+        node_ts = jnp.where(is_node_slot, ts_g, BIG)
+        node_ts = jnp.where(slot_ids == ROOT, jnp.int64(0), node_ts)
+        node_pos = jnp.where(is_node_slot,
+                             (ap_g & 0xFFFFFFFF).astype(jnp.int32), IPOS)
+        # anchor-parent slot+found, masked like pa_n below (non-node
+        # slots read as NULL, matching what pslot[aslot] would yield)
+        ansl = jnp.where(is_node_slot, (ap_g >> 32).astype(jnp.int32),
+                         jnp.int32(NULL << 1))
     node_depth = jnp.where(is_node_slot, (dsv >> 33).astype(jnp.int32),
                            0).at[ROOT].set(0)
     node_anchor_is_sentinel = is_node_slot & \
@@ -804,8 +895,15 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     depth_ok = (node_depth >= 1) & (node_depth <= D) & \
         (node_depth == par_depth + 1)
     parent_ok = pfound & depth_ok & prefix_ok
+    if fused:
+        # the anchor's parent slot was host-derived and rode the plane
+        # gather (``ansl``): the sibling check is elementwise instead of
+        # one more M-wide gather through aslot
+        anchor_parent = ansl >> 1
+    else:
+        anchor_parent = pslot[aslot]
     anchor_ok = node_anchor_is_sentinel | \
-        (afound & (pslot[aslot] == pslot) & (aslot != ROOT))
+        (afound & (anchor_parent == pslot) & (aslot != ROOT))
     local_ok = is_node_slot & (node_ts > 0) & parent_ok & anchor_ok
     local_ok = local_ok.at[ROOT].set(True)
     if probe is not None:
@@ -1022,12 +1120,27 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
 
         def br_small(_):
             at = jnp.where(crowded, cpos, S_CAP)
-            kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                skey, mode="drop", unique_indices=True)
-            gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
-                ggrp, mode="drop", unique_indices=True)
-            neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                neg_slot, mode="drop", unique_indices=True)
+            if _pack_gather_on():
+                # the three compaction columns share ONE index: one
+                # [S_CAP, 2] multi-column scatter (key+group bit-packed
+                # — skey ≤ NULL < 2^30; IPOS padding unpacks to a key
+                # that still sorts after every real row, and padding
+                # detection stays ``neg == IPOS`` as before)
+                vals = jnp.stack(
+                    [(skey << 1) | ggrp.astype(jnp.int32), neg_slot],
+                    axis=-1)
+                kgn = jnp.full((S_CAP, 2), IPOS, jnp.int32).at[at].set(
+                    vals, mode="drop", unique_indices=True)
+                kp = kgn[:, 0] >> 1
+                gg = (kgn[:, 0] & 1).astype(jnp.int8)
+                neg = kgn[:, 1]
+            else:
+                kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                    skey, mode="drop", unique_indices=True)
+                gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
+                    ggrp, mode="drop", unique_indices=True)
+                neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
+                    neg_slot, mode="drop", unique_indices=True)
             sib, fc = _sib_links(kp, gg, neg)
             # singleton children: the parent's whole child list
             single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
@@ -1041,19 +1154,15 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             concurrent-editor shape (every op a sibling under one
             anchor: adversarial configs 6/7 put ~1M rows here) — so the
             sorted order is analytically slot-DESCENDING and the links
-            build from the crowding compaction with no sort: sib_next
-            follows cpos-1 (the next smaller slot), first_child of the
-            one key is the largest slot (cpos = n_crowded-1)."""
-            idx_by_cpos = jnp.full(M, -1, jnp.int32).at[
-                jnp.where(crowded, cpos, M)].set(
-                    slot_ids, mode="drop", unique_indices=True)
-            nxt = jnp.where(
-                crowded & (cpos > 0),
-                idx_by_cpos[jnp.maximum(cpos - 1, 0)], -1)
-            sib = jnp.full(M, -1, jnp.int32).at[
-                jnp.where(crowded, slot_ids, M)].set(
-                    nxt, mode="drop", unique_indices=True)
-            head = idx_by_cpos[jnp.maximum(n_crowded - 1, 0)]
+            build with no sort, no scatter and no gather: each crowded
+            slot's sib_next is the previous crowded slot (one running
+            max), first_child of the one key is the largest crowded
+            slot (a reduce)."""
+            pc = lax.cummax(jnp.where(crowded, slot_ids, -1))
+            prev = jnp.concatenate(
+                [jnp.full(1, -1, jnp.int32), pc[:-1]])
+            sib = jnp.where(crowded, prev, -1)
+            head = jnp.max(jnp.where(crowded, slot_ids, -1))
             gkey = jnp.clip(jnp.max(jnp.where(crowded, skey, -1)),
                             0, M - 1)
             fc = jnp.full(M, -1, jnp.int32).at[gkey].set(head)
@@ -1143,15 +1252,16 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     same_run = fwd | bwd | (loop_[:-1] & loop_[1:])
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
     rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
-    end_mask = jnp.concatenate([boundary[1:], jnp.ones(1, bool)])
-    # one unique-set scatter per bound (each run has exactly one start
-    # and one end token) — cheaper than min/max combiner scatters
+    # one unique-set scatter for the starts (each run has exactly one
+    # start token); runs TILE the token axis contiguously (rid is a
+    # boundary cumsum), so each run ends where the next begins — run_e
+    # derives elementwise instead of paying a second M-wide scatter
     run_s = jnp.full(T, IPOS, jnp.int32).at[
         jnp.where(boundary, rid, T)].set(tok, mode="drop",
                                          unique_indices=True)
-    run_e = jnp.zeros(T, jnp.int32).at[
-        jnp.where(end_mask, rid, T)].set(tok, mode="drop",
-                                         unique_indices=True)
+    next_s = jnp.concatenate([run_s[1:], jnp.full(1, IPOS, jnp.int32)])
+    run_e = jnp.where(run_s == IPOS, 0,
+                      jnp.where(next_s == IPOS, T - 1, next_s - 1))
 
     # Token weights and their exclusive prefix sums.  Only ENTER tokens
     # (the first M) carry weight — exit tokens count nothing — so the
@@ -1162,10 +1272,13 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # is the merged self-loop block across M-1/M, which is terminal and
     # zero-weight — its window reads are clamped and then zeroed by
     # ``run_terminal`` in _expand, so the clamp never mis-weights it.
-    cse_doc = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), lax.cumsum(exists.astype(jnp.int32))])
-    cse_vis = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), lax.cumsum(visible.astype(jnp.int32))])
+    # both weight columns ride ONE batched scan (two lanes of a [2, M]
+    # cumsum price like one M-wide pass, not two)
+    cs = lax.cumsum(jnp.stack([exists.astype(jnp.int32),
+                               visible.astype(jnp.int32)]), axis=1)
+    z1 = jnp.zeros(1, jnp.int32)
+    cse_doc = jnp.concatenate([z1, cs[0]])
+    cse_vis = jnp.concatenate([z1, cs[1]])
 
     def _expand(run_s_w, run_e_w):
         """Per-run chain data at width ``run_s_w.shape[0]`` → Wyllie →
@@ -1283,7 +1396,11 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     a_valid = (a_meta & 1) != 0
     a_parent_ok = (a_meta & 2) != 0
     a_grandvalid = (a_meta & 4) != 0     # valid[pslot[a_slot]]
-    a_absorbed = a_valid & (anc_del[a_slot] < pos)
+    # statically no ancestor delete under the no-deletes promise: the
+    # anc_del frame is a constant there, so the gather would be a dead
+    # M-wide op the chain budget still counts at trace level
+    a_absorbed = False if no_deletes else \
+        a_valid & (anc_del[a_slot] < pos)
     # an Add with ts 0 collides with the branch-head sentinel: the reference
     # finds an existing child and reports AlreadyApplied
     a_sentinel = ts <= 0
@@ -1347,5 +1464,5 @@ def materialize(ops: Dict[str, jax.Array],
     no_deletes = host_no_deletes(ops.get("kind"))
     if jax.config.jax_enable_x64:
         return _materialize(ops, use_pallas, hints, no_deletes)
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         return _materialize(ops, use_pallas, hints, no_deletes)

@@ -26,6 +26,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..utils import jaxcompat
+
 TILE = 1024      # tokens per grid step (matches XLA's s32[N] T(1024) layout)
 SPAN = TILE + 128  # values rows DMA'd per tile (≥ TILE+128: aligned starts)
 
@@ -141,6 +143,6 @@ def monotone_gather(values: jax.Array, rid: jax.Array,
     # x64 emits index/grid ops Mosaic cannot legalize ('func.func'), so
     # scope it to x32 — caller dtypes are unaffected (no-op when x64 is
     # already off)
-    with jax.enable_x64(False):
+    with jaxcompat.enable_x64(False):
         out = _pallas_call(vals_pad, rid_pad, starts, v8, tiles, interpret)
     return out[:v, :t]

@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..codec.packed import KIND_PAD, PackedOps
 from ..codec.packed import pad_arrays as packed_pad_arrays
 from ..ops import merge as merge_mod
+from ..utils import jaxcompat
 from ..ops.merge import NodeTable
 
 DOCS_AXIS = "docs"
@@ -100,7 +101,7 @@ def sharded_materialize(ops: Dict[str, np.ndarray], mesh: Mesh) -> NodeTable:
 
     if jax.config.jax_enable_x64:
         return run()
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         return run()
 
 
@@ -159,7 +160,7 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
 
     if jax.config.jax_enable_x64:
         return run()
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         return run()
 
 
@@ -193,4 +194,10 @@ def stack_packed(batches: Sequence[PackedOps]) -> Dict[str, np.ndarray]:
             wide[:, :arrs["paths"].shape[1]] = arrs["paths"]
             arrs["paths"] = wide
         per.append(_pad_ops_to(arrs, n))
-    return {k: np.stack([d[k] for d in per]) for k in per[0]}
+    # derived slot-hint columns ride along only when EVERY document has
+    # them (arrays() omits them for unvouched batches; a mixed stack
+    # takes the gather-based resolution rather than trusting half)
+    keys = set(per[0])
+    for d in per[1:]:
+        keys &= set(d)
+    return {k: np.stack([d[k] for d in per]) for k in keys}

@@ -1,0 +1,254 @@
+"""Trace-time chain-length auditor: counts the M-wide memory ops of a
+jitted function — the merge kernel's CI-pinned performance budget.
+
+The round-5 on-chip cost model (docs/TPU_PROFILE.md §3-4,
+PRIMS_TPU_r05.txt) is: every 1M-wide random-access memory op — gather,
+scatter, sort, scan — costs ~6 ms of device time on v5e regardless of
+payload width, and the clean kernel is a ~53-op dependency chain of
+them (393 ms ≈ 53 × 6 ms + RTT).  The <100 ms north star therefore
+needs the chain cut to ≤16 — a number that was a projection until this
+module: it walks the kernel's JAXPR and counts the wide memory ops the
+model bills, so the budget is asserted in a tier-1 test
+(tests/test_chain_audit.py) instead of re-derived per grant window.
+
+Counting rules (the model's, not HLO's):
+
+- counted primitives: ``gather``, every ``scatter`` variant, ``sort``,
+  and the scans (``cumsum``/``cummax``/``cumprod``/``cumlogsumexp``) —
+  the serialized random/sequential-access passes.  A ``pallas_call``
+  counts as ONE op (that is the point of fusing).  Elementwise ops,
+  reductions, concats/pads/slices are free: XLA fuses them into
+  neighbours and the prims probe shows them at the dispatch floor.
+- an op is M-wide when its RANDOM-ACCESS width — gathered-row /
+  scattered-update count, sorted or scanned length — reaches the
+  threshold (default: a quarter of the widest input axis, so
+  S_CAP/R_CAP-compacted stages stay free at headline scale, as the
+  cost model prices them).
+- ``cond`` branches: the FAST-path count takes the cheapest branch
+  (production/causal logs take the compact branches; the adversarial
+  fallbacks are priced separately by ``static``, which takes the most
+  expensive single execution).  ``while`` bodies: fast-path assumes 0
+  trips (the kernel's fixpoint loops exit in 0 trips on causal logs —
+  their convergence tests are elementwise+reduce); the body's count is
+  reported per trip so a regression hiding work inside a loop is still
+  visible in ``rows``.
+
+Run as a module for the audit table of any config:
+
+    python -m crdt_graph_tpu.utils.chainaudit [config_id ...]
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+# the serialized-access primitives the cost model bills (~6 ms each at
+# 1M width on v5e)
+_SCATTERS = ("scatter", "scatter-add", "scatter-min", "scatter-max",
+             "scatter-mul", "scatter-apply")
+_SCANS = ("cumsum", "cummax", "cumprod", "cumlogsumexp")
+_CALLS = ("pjit", "closed_call", "core_call", "remat", "remat2",
+          "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+          "checkpoint")
+
+
+@dataclasses.dataclass
+class ChainAudit:
+    """Result of :func:`count_mwide`.
+
+    ``fast_path``: memory ops on the production fast path (cheapest
+    cond branches, 0-trip loops) — the CI-pinned budget number.
+    ``static``: the most expensive single execution (max cond branch,
+    one trip per while body) — the adversarial-shape ceiling.
+    ``rows``: (path, primitive, width, note) per counted op, fast path
+    first; loop-body and slow-branch ops carry a disambiguating note.
+    """
+    fast_path: int
+    static: int
+    threshold: int
+    rows: List[Tuple[str, str, int, str]]
+
+    def table(self) -> str:
+        lines = [f"threshold {self.threshold} | fast_path "
+                 f"{self.fast_path} | static {self.static}"]
+        for path, prim, width, note in self.rows:
+            lines.append(f"  {prim:14s} {width:>10d}  {note:10s} {path}")
+        return "\n".join(lines)
+
+
+def _aval_size(v) -> int:
+    try:
+        return int(np.prod(v.aval.shape)) if v.aval.shape else 1
+    except Exception:
+        return 0
+
+
+def _width(eqn) -> int:
+    """The op's random/serial-access width under the cost model."""
+    name = eqn.primitive.name
+    if name == "gather":
+        idx = eqn.invars[1]
+        shape = idx.aval.shape
+        return int(np.prod(shape[:-1])) if len(shape) else 1
+    if name in _SCATTERS:
+        idx = eqn.invars[1]
+        shape = idx.aval.shape
+        return int(np.prod(shape[:-1])) if len(shape) else 1
+    if name == "sort":
+        dim = eqn.params.get("dimension", 0)
+        return int(eqn.invars[0].aval.shape[dim])
+    if name in _SCANS:
+        ax = eqn.params.get("axis", 0)
+        return int(eqn.invars[0].aval.shape[ax])
+    if name == "pallas_call":
+        return max((_aval_size(v) for v in eqn.outvars), default=0)
+    return max((_aval_size(v) for v in eqn.invars), default=0)
+
+
+def _sub_jaxprs(params: Dict[str, Any]):
+    from jax._src import core as jcore
+    for v in params.values():
+        if isinstance(v, jcore.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jcore.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jcore.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, jcore.Jaxpr):
+                    yield x
+
+
+def _count(jaxpr, threshold: int, path: str, note: str,
+           rows: List[Tuple[str, str, int, str]]) -> Tuple[int, int]:
+    fast = static = 0
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        here = f"{path}.{i}:{name}"
+        if name == "cond":
+            branches = eqn.params["branches"]
+            counts = []
+            for bi, br in enumerate(branches):
+                sub_rows: List[Tuple[str, str, int, str]] = []
+                f, s = _count(br.jaxpr, threshold, f"{here}[br{bi}]",
+                              note, sub_rows)
+                counts.append((f, s, sub_rows))
+            f_min = min(c[0] for c in counts)
+            s_max = max(c[1] for c in counts)
+            # report the fast branch's rows under their own notes,
+            # every other branch's as slow-path
+            fast_bi = min(range(len(counts)),
+                          key=lambda b: counts[b][0])
+            for bi, (f, s, sub_rows) in enumerate(counts):
+                for r in sub_rows:
+                    rows.append(r if bi == fast_bi else
+                                (r[0], r[1], r[2], "slow-branch"))
+            fast += f_min
+            static += s_max
+        elif name == "while":
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                sub = eqn.params[key].jaxpr
+                sub_rows = []
+                f, s = _count(sub, threshold, f"{here}[{key}]",
+                              "loop-body", sub_rows)
+                rows.extend(sub_rows)
+                # fast path: 0 trips (the kernel's fixpoint loops);
+                # static: one trip
+                static += s if key == "body_jaxpr" else 0
+        elif name == "scan":
+            sub = eqn.params["jaxpr"].jaxpr
+            f, s = _count(sub, threshold, f"{here}[body]", "scan-body",
+                          rows)
+            length = int(eqn.params.get("length", 1))
+            fast += f * length
+            static += s * length
+        elif name in _CALLS or "call" in name and "pallas" not in name:
+            for sub in _sub_jaxprs(eqn.params):
+                f, s = _count(sub, threshold, f"{here}", note, rows)
+                fast += f
+                static += s
+        else:
+            w = _width(eqn)
+            counted = (name == "gather" or name in _SCATTERS or
+                       name == "sort" or name in _SCANS or
+                       name == "pallas_call")
+            if counted and w >= threshold:
+                rows.append((here, name, w, note or "fast"))
+                fast += 1
+                static += 1
+    return fast, static
+
+
+def count_mwide(fn, *args, threshold: Optional[int] = None,
+                **jaxpr_kwargs) -> ChainAudit:
+    """Audit ``fn(*args)``'s trace.  ``args`` may be arrays or
+    ``jax.ShapeDtypeStruct``s (tracing is shape-only — auditing the 1M
+    production trace costs milliseconds, no device work).
+
+    ``threshold``: minimum random-access width to bill; default = 1/4
+    of the widest leading axis among the array arguments."""
+    closed = jax.make_jaxpr(fn, **jaxpr_kwargs)(*args)
+    if threshold is None:
+        widest = 1
+        for leaf in jax.tree_util.tree_leaves(args):
+            shape = getattr(leaf, "shape", ())
+            if shape:
+                widest = max(widest, int(shape[0]))
+        threshold = max(widest // 4, 1)
+    rows: List[Tuple[str, str, int, str]] = []
+    fast, static = _count(closed.jaxpr, threshold, "", "", rows)
+    rows.sort(key=lambda r: ({"fast": 0}.get(r[3], 1), -r[2]))
+    return ChainAudit(fast_path=fast, static=static,
+                      threshold=threshold, rows=rows)
+
+
+MODELED_MS_PER_OP = 6.0   # measured: PRIMS_TPU_r05.txt while-loop row
+
+
+def audit_materialize(ops: Dict[str, np.ndarray], hints: str,
+                      no_deletes: bool,
+                      threshold: Optional[int] = None) -> ChainAudit:
+    """Audit the merge kernel's production trace for an op-column dict
+    (shape-only; the arrays are never touched)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ..ops import merge as merge_mod
+
+    shapes = {k: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                      np.asarray(v).dtype)
+              for k, v in ops.items()}
+    fn = functools.partial(merge_mod._materialize.__wrapped__,
+                           use_pallas=False, hints=hints,
+                           no_deletes=no_deletes)
+    del jnp
+    return count_mwide(fn, shapes, threshold=threshold)
+
+
+def _main(argv) -> None:  # pragma: no cover - CLI convenience
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from ..bench import workloads
+    ids = [int(a) for a in argv] or [5]
+    for cid in ids:
+        name, gen = workloads.CONFIGS[cid]
+        raw = gen()
+        if not isinstance(raw, dict):
+            from ..codec import packed as packed_mod
+            raw = packed_mod.pack(raw).arrays()
+        no_del = not bool(np.any(raw["kind"] == 1))
+        audit = audit_materialize(raw, "exhaustive", no_del)
+        print(f"== config {cid} ({name}) modeled "
+              f"{audit.fast_path * MODELED_MS_PER_OP:.0f} ms on-chip ==")
+        print(audit.table())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+    _main(sys.argv[1:])

@@ -1,0 +1,39 @@
+"""Version-compat shims for the few JAX surfaces this repo uses that
+have moved across the jax versions the environment has shipped.
+
+The package targets the current public names (``jax.enable_x64``,
+``jax.shard_map`` with ``check_vma``); the image's installed jax
+(0.4.37) still exports them as ``jax.experimental.enable_x64`` and
+``jax.experimental.shard_map.shard_map`` with ``check_rep``.  Every
+call site routes through here so the version skew lives in one file —
+under the older jax the bare attributes raise ``AttributeError`` at
+CALL time (jax's deprecation getattr), which silently broke every
+pallas-interpret and shard_map test until round 6.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def enable_x64(new_val: bool = True):
+    """``with enable_x64(...)``: scoped x64 mode, whichever spelling
+    the installed jax exports."""
+    try:
+        return jax.enable_x64(new_val)
+    except AttributeError:
+        from jax.experimental import enable_x64 as _cm
+        return _cm(new_val)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` under its current or pre-0.5 spelling (where
+    ``check_vma`` was named ``check_rep``)."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+        kw = {} if check_vma is None else {"check_rep": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    kw = {} if check_vma is None else {"check_vma": check_vma}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

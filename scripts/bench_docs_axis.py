@@ -1,0 +1,105 @@
+"""Config-5 "v5e-8 slice" settled on the DOCS axis (VERDICT r5 next-4):
+8 × 1M-op independent merges through ``mesh.batched_materialize`` on
+the 8-device CPU mesh, against the same 8 merges run sequentially on
+one device.
+
+The explicit op-axis schedule is 8.7× SLOWER than single-device for a
+single 1M merge (docs/SHARD_TAIL.md §2: replicated tail, Amdahl ceiling
+~1.3-1.6×), so the honest 8-chip story for config 5 is throughput, not
+latency: the slice serves 8 documents, one merge each, zero cross-doc
+communication.  This script produces the measured aggregate-ops/s rows
+SHARD_TAIL.md §6 commits.
+
+Usage: python scripts/bench_docs_axis.py [n_docs] [ops_per_doc]
+       (defaults 8 1000000; CPU-pinned, 8 virtual devices)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from crdt_graph_tpu.utils import hostenv  # noqa: E402
+
+hostenv.scrub_tpu_env(8)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import workloads  # noqa: E402
+from crdt_graph_tpu.ops import merge as merge_mod  # noqa: E402
+from crdt_graph_tpu.parallel import mesh as mesh_mod  # noqa: E402
+
+
+def _doc_workload(doc: int, n_ops: int) -> dict:
+    """An independent config-5-shaped document: same 64-chain structure,
+    disjoint replica-id space per document (honest distinct documents,
+    not one array aliased 8 times)."""
+    arrs = dict(workloads.chain_workload(64, n_ops))
+    shift = np.int64(doc * 64) << 32
+    for k in ("ts", "anchor_ts"):
+        arrs[k] = np.where(arrs[k] > 0, arrs[k] + shift, arrs[k])
+    arrs["paths"] = np.where(arrs["paths"] > 0, arrs["paths"] + shift,
+                             arrs["paths"])
+    return arrs
+
+
+def main() -> None:
+    n_docs = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    per_doc = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
+    docs = [_doc_workload(d, per_doc) for d in range(n_docs)]
+    stacked = {k: np.stack([d[k] for d in docs]) for k in docs[0]}
+    mesh = mesh_mod.make_mesh(n_docs=n_docs, n_ops=1)
+    total = n_docs * per_doc
+
+    def batched():
+        t = mesh_mod.batched_materialize(stacked, mesh,
+                                         exhaustive_hints=True)
+        jax.block_until_ready(t.num_visible)
+        return t
+
+    t0 = time.perf_counter()
+    table = batched()
+    compile_s = time.perf_counter() - t0
+    assert np.all(np.asarray(table.num_visible) == per_doc), \
+        np.asarray(table.num_visible)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        batched()
+        times.append(time.perf_counter() - t0)
+    batched_s = sorted(times)[len(times) // 2]
+
+    # sequential single-device comparison: the same 8 documents, one
+    # whole-array merge each, on one device (the production trace)
+    def seq_one(arrs):
+        dev = jax.device_put(arrs)
+        t = merge_mod._materialize(dev, False, "exhaustive", True)
+        jax.block_until_ready(t.num_visible)
+
+    seq_one(docs[0])              # compile once (shared trace)
+    t0 = time.perf_counter()
+    for d in docs:
+        seq_one(d)
+    seq_s = time.perf_counter() - t0
+
+    print(json.dumps({
+        "n_docs": n_docs, "ops_per_doc": per_doc,
+        "host_cores": os.cpu_count(),
+        "mesh": "docs=%d x ops=1 (virtual CPU devices)" % n_docs,
+        "batched_p50_s": round(batched_s, 2),
+        "batched_agg_ops_per_s": round(total / batched_s, 1),
+        "batched_compile_s": round(compile_s, 1),
+        "seq_single_device_s": round(seq_s, 2),
+        "seq_agg_ops_per_s": round(total / seq_s, 1),
+        "batched_vs_seq": round(seq_s / batched_s, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Interactive-scale serving SLO (VERDICT r5 next-7): p50/p99 latency
+of ``POST /docs/{id}/ops`` for the three editor-shaped delta sizes —
+1 op (keystroke), 64 ops (sync burst), 4096 ops (reconnect catch-up) —
+through the real HTTP service and the ServingEngine scheduler.
+
+The sizes bracket the engine's routing thresholds (engine.apply):
+1 and 64 ≤ DELTA_THRESHOLD=256 ride the O(delta) host mirror; 4096
+crosses ``packed_route`` (n ≥ max(1024, log/8)) and dispatches the
+device kernel — the crossover whose two sides the SLO table in
+docs/SERVING.md documents.  tests/test_slo_routing.py pins the routing
+itself (a sub-threshold delta NEVER dispatches the kernel); this
+script prices it.
+
+Usage: python scripts/bench_slo.py [bootstrap_ops] [reps]
+       (defaults 8192 60; CPU-pinned unless the driver says otherwise)
+"""
+import json
+import sys
+import threading
+import time
+from http.client import HTTPConnection
+
+sys.path.insert(0, "/root/repo")
+
+from crdt_graph_tpu.utils import hostenv  # noqa: E402
+
+hostenv.scrub_tpu_env(1)
+
+import numpy as np  # noqa: E402
+
+from crdt_graph_tpu.codec import json_codec  # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch  # noqa: E402
+from crdt_graph_tpu.service import make_server  # noqa: E402
+
+OFFSET = 2**32
+
+
+def _delta(replica: int, counter: int, anchor: int, size: int):
+    ops = []
+    prev = anchor
+    for _ in range(size):
+        counter += 1
+        ts = replica * OFFSET + counter
+        ops.append(Add(ts, (prev,), counter % 997))
+        prev = ts
+    return Batch(tuple(ops)), counter, prev
+
+
+def main() -> None:
+    bootstrap = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+    srv = make_server(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_port
+
+    def post(doc, body):
+        conn = HTTPConnection("127.0.0.1", port, timeout=600)
+        conn.request("POST", f"/docs/{doc}/ops", body=body)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    rows = []
+    for size in (1, 64, 4096):
+        doc = f"slo{size}"
+        counter, anchor = 0, 0
+        boot, counter, anchor = _delta(7, counter, anchor, bootstrap)
+        st, out = post(doc, json_codec.dumps(boot))
+        assert st == 200 and json.loads(out)["accepted"], out[:200]
+        n = reps if size < 4096 else max(reps // 3, 10)
+        # pre-encode all bodies: the SLO times the service, not the
+        # bench's own op-object churn
+        bodies = []
+        for _ in range(n + 3):
+            d, counter, anchor = _delta(7, counter, anchor, size)
+            bodies.append(json_codec.dumps(d))
+        lats = []
+        for i, body in enumerate(bodies):
+            t0 = time.perf_counter()
+            st, out = post(doc, body)
+            dt = (time.perf_counter() - t0) * 1e3
+            assert st == 200 and json.loads(out)["accepted"], out[:200]
+            if i >= 3:                      # warmup requests excluded
+                lats.append(dt)
+        lats.sort()
+        rows.append({
+            "delta_ops": size,
+            "requests": len(lats),
+            "p50_ms": round(lats[len(lats) // 2], 2),
+            "p99_ms": round(lats[min(len(lats) - 1,
+                                     int(len(lats) * 0.99))], 2),
+            "max_ms": round(lats[-1], 2),
+            "route": "host mirror (<= DELTA_THRESHOLD)" if size <= 256
+                     else "kernel (packed_route)",
+            "bootstrap_ops": bootstrap,
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,6 +143,34 @@ def main():
                        (jnp.int32(0), a))[1]), i32k, idxK)
     row("20x dependent elementwise N", lambda a: fp(
         _chain_elementwise(a, 20)), i32a)
+    # ---- round-6 fused-resolution layouts (rows 28-31): the exact
+    # shapes the restructured kernel ships (ops/merge.py fused path,
+    # chain budget utils/chainaudit.py) — price each against the
+    # single-primitive rows above to confirm the ≤16-op model's
+    # assumption that one packed pass costs ~one pass.
+    plane5 = jnp.tile(i64N[:, None], (1, 5))
+    row("gather [N,5] i64 fused plane", lambda p, i: fp(p[i]),
+        plane5, idxN)
+    S = 65_536
+    row("scatter [64k,2] i32 packed (N idx)", lambda v, i: fp(
+        jnp.full((S, 2), 2**31 - 1, jnp.int32).at[
+            jnp.where(i < S, i, S)].set(
+            jnp.stack([v, v ^ 5], -1), mode="drop",
+            unique_indices=True)), i32a, idxN)
+    row("cumsum [2,N] batched", lambda a: fp(
+        lax.cumsum(jnp.stack([a, a ^ 3]), axis=1)), i32a)
+    # near-diagonal index (the production nsr shape: rank order ==
+    # array order ± jitter) so the bounded-span kernel path, not its
+    # lax fallback, is what gets priced
+    diag = jnp.clip(jnp.arange(N, dtype=jnp.int32) + (idxN % 97) - 48,
+                    0, N - 1)
+    row("pallas span_row_gather [N,5] i64", lambda p, i: fp(
+        _span_rows(p, i)), plane5, diag)
+
+
+def _span_rows(p, i):
+    from crdt_graph_tpu.ops import fused_resolve
+    return fused_resolve.plane_rows(p, i)
 
 
 def _chain_elementwise(a, k):

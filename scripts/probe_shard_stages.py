@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 from crdt_graph_tpu.bench import honest  # noqa: E402
 from crdt_graph_tpu.bench.workloads import chain_workload  # noqa: E402
 from crdt_graph_tpu.ops import merge as merge_mod  # noqa: E402
+from crdt_graph_tpu.utils import jaxcompat  # noqa: E402
 from crdt_graph_tpu.parallel import shard as shard_mod  # noqa: E402
 from crdt_graph_tpu.parallel.mesh import OPS_AXIS, _pad_ops_to, round_up  # noqa: E402
 
@@ -67,7 +68,7 @@ def main():
 
     # --- resolve-only: the shard_map'd phase, checksum-forced
     body = functools.partial(shard_mod._resolve_local, N, M, False)
-    resolve = jax.shard_map(body, mesh=mesh,
+    resolve = jaxcompat.shard_map(body, mesh=mesh,
                             in_specs=tuple(
                                 P(OPS_AXIS) if device_ops[c].ndim == 1
                                 else P(OPS_AXIS, None)

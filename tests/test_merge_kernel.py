@@ -16,6 +16,7 @@ from crdt_graph_tpu import Add, Batch, Delete
 from crdt_graph_tpu.codec import packed
 from crdt_graph_tpu.core import operation as op_mod
 from crdt_graph_tpu.ops import merge, view
+from crdt_graph_tpu.utils import jaxcompat
 
 OFFSET = 2**32
 
@@ -446,7 +447,7 @@ def test_no_deletes_trace_parity():
     arrs = p.arrays()
     assert merge.host_no_deletes(arrs["kind"])
     import jax
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         lean = view.to_host(merge._materialize(arrs, None, None, True))
         full = view.to_host(merge._materialize(arrs, None, None, False))
     for f in ("ts", "parent", "depth", "value_ref", "exists", "tombstone",
@@ -470,7 +471,7 @@ def test_probe_cuts_run_every_stage():
     for op_set in (ops, [op for op in ops if not isinstance(op, Delete)]):
         arrs = packed.pack(op_set).arrays()
         nd = merge.host_no_deletes(arrs["kind"])
-        with jax.enable_x64(True):
+        with jaxcompat.enable_x64(True):
             for k in range(1, 8):
                 out = merge._materialize(arrs, None, "exhaustive", nd, k)
                 assert np.asarray(out).shape == (), k
@@ -567,7 +568,7 @@ def test_split_pack_roundtrip_edges():
     vals = np.array([0, 1, 2**31 - 1, 2**31, 2**32 - 1, 2**32,
                      2**32 + 2**31, 5 * 2**32 + (2**32 - 1),
                      merge.BIG - 1, merge.BIG], dtype=np.int64)
-    with jax.enable_x64(True):     # bare asarray would truncate to i32
+    with jaxcompat.enable_x64(True):     # bare asarray would truncate to i32
         v = jnp.asarray(vals)
         h, l = merge._split_u(v)
         assert np.array_equal(np.asarray(merge._pack_u(h, l)), vals)
@@ -635,7 +636,8 @@ def test_pack_gather_layout_bit_identity(monkeypatch):
             arrs, parallel.make_mesh(n_ops=8))
         return out
 
-    monkeypatch.delenv("GRAFT_PACK_GATHER", raising=False)
+    # default is ON (round 6): pin the two legs explicitly either way
+    monkeypatch.setenv("GRAFT_PACK_GATHER", "0")
     jax.clear_caches()
     base = tables()
     monkeypatch.setenv("GRAFT_PACK_GATHER", "1")

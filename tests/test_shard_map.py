@@ -2,6 +2,8 @@
 kernel: bit-identical tables on large mixed batches, adversarial shapes,
 and hostile hints, on the simulated 8-device CPU mesh (VERDICT r3
 missing-2 "done" criteria)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -13,6 +15,13 @@ from crdt_graph_tpu.codec import packed
 from crdt_graph_tpu.ops import merge, view
 from crdt_graph_tpu.parallel import mesh as mesh_mod
 from crdt_graph_tpu.parallel import shard
+from crdt_graph_tpu.utils import jaxcompat
+
+# the 256k bit-identity suite runs with the packed multi-column layout
+# pinned ON (the round-6 default; a hard set so neither an exported
+# B-leg override nor a future default change can silently weaken what
+# this file proves)
+os.environ["GRAFT_PACK_GATHER"] = "1"
 
 FIELDS = ("ts", "parent", "depth", "value_ref", "paths", "exists",
           "tombstone", "dead", "visible", "doc_index", "order",
@@ -116,7 +125,7 @@ def test_collective_volume_explicit_vs_auto(ops_mesh):
     mesh = ops_mesh
     padded = mesh_mod._pad_ops_to(
         arrs, mesh_mod.round_up(arrs["kind"].shape[0], 8))
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         dev = {k: jax.device_put(
             v, NamedSharding(mesh, P("ops") if v.ndim == 1
                              else P("ops", None)))

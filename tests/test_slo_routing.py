@@ -1,0 +1,87 @@
+"""SLO routing contract (VERDICT r5 next-7): a delta at or under
+DELTA_THRESHOLD must NEVER dispatch the device kernel — the interactive
+editor path is the O(delta) host mirror, whatever the service layers
+above do with the batch."""
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import crdt_graph_tpu as crdt  # noqa: E402
+from crdt_graph_tpu import engine as engine_mod  # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch  # noqa: E402
+from crdt_graph_tpu.ops import merge as merge_mod  # noqa: E402
+
+OFFSET = 2**32
+
+
+def _chain(replica, counter, anchor, size):
+    ops = []
+    prev = anchor
+    for _ in range(size):
+        counter += 1
+        ts = replica * OFFSET + counter
+        ops.append(Add(ts, (prev,), counter))
+        prev = ts
+    return Batch(tuple(ops)), counter, prev
+
+
+@pytest.fixture()
+def no_kernel(monkeypatch):
+    """Arms a tripwire: any device-kernel dispatch fails the test."""
+    def _boom(*a, **k):
+        raise AssertionError("device kernel dispatched for a "
+                             "sub-threshold delta")
+    monkeypatch.setattr(merge_mod, "materialize", _boom)
+    monkeypatch.setattr(engine_mod.merge_mod, "materialize", _boom,
+                        raising=False)
+    yield
+
+
+def test_engine_small_deltas_stay_on_host_path(no_kernel):
+    t = engine_mod.init(1)
+    counter, anchor = 0, 0
+    # threshold-sized, single-op, and mid-sized deltas from a peer
+    for size in (1, 64, engine_mod.DELTA_THRESHOLD):
+        delta, counter, anchor = _chain(9, counter, anchor, size)
+        t.apply(delta)
+    assert len(t.visible_values()) == counter
+
+
+def test_serving_engine_small_deltas_stay_on_host_path(no_kernel):
+    from crdt_graph_tpu.codec import json_codec
+    from crdt_graph_tpu.serve import ServingEngine
+
+    eng = ServingEngine()
+    counter, anchor = 0, 0
+    try:
+        for size in (1, 64, engine_mod.DELTA_THRESHOLD):
+            delta, counter, anchor = _chain(9, counter, anchor, size)
+            accepted, _ = eng.submit("slo", json_codec.dumps(delta))
+            assert accepted
+        snap = eng.get("slo").snapshot
+        assert snap is not None
+    finally:
+        eng.close()
+
+
+def test_above_threshold_crosses_to_kernel(monkeypatch):
+    """The complementary direction: once packed_route says kernel, the
+    kernel really is what runs (so the SLO table's two sides are the
+    two real paths, not one path measured twice)."""
+    calls = []
+    real = merge_mod.materialize
+
+    def _spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(engine_mod.merge_mod, "materialize", _spy)
+    t = engine_mod.init(1)
+    n = max(4 * engine_mod.DELTA_THRESHOLD, 1100)
+    delta, _, _ = _chain(9, 0, 0, n)
+    t.apply(delta)
+    assert calls, "large delta should have dispatched the kernel"
+    assert len(t.visible_values()) == n
