@@ -136,6 +136,10 @@ def _child() -> None:
                        "in every timed repeat)",
         "audit": stats["audit"],
         "dispatch_overhead_ms": stats["dispatch_overhead_ms"],
+        # trace-audit record (op count + width-weighted modeled ms +
+        # budget verdict): keeps the perf trajectory attached to the
+        # cost model even when this row is a CPU-fallback number
+        "chain_audit": stats.get("chain_audit"),
     }), flush=True)
 
 

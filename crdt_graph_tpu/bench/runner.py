@@ -115,8 +115,8 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         args = (dev_ops,) if expected_ts is None else \
             (dev_ops, jax.device_put(expected_ts))
     _log("arrays on device")
-    fn = _summary_fn(no_deletes=merge.host_no_deletes(
-        np.asarray(ops["kind"])), hints=hints)
+    no_deletes = merge.host_no_deletes(np.asarray(ops["kind"]))
+    fn = _summary_fn(no_deletes=no_deletes, hints=hints)
     stats = honest.time_with_readback(fn, *args, repeats=repeats, log=_log)
     _, num_nodes, num_visible, order_ok = stats["last_result"]
     n = int(np.sum(np.asarray(ops["kind"]) != packed_mod.KIND_PAD))
@@ -139,6 +139,17 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
         # roofline argument, not a substitute headline.
         "p50_minus_rtt_ms": round(max(stats["p50_ms"] - floor_ms, 0.0), 2),
     }
+    # shape-only trace audit (utils/chainaudit): op count + width-
+    # weighted modeled ms + budget verdict ride every stats row, so the
+    # perf trajectory tracks the model even when the round-end bench
+    # falls back to CPU (ISSUE 3 satellite).  Never fatal: a bench row
+    # without an audit beats no bench row.
+    try:
+        from ..utils import chainaudit
+        out["chain_audit"] = chainaudit.audit_summary(
+            ops, hints or "auto", no_deletes)
+    except Exception as e:  # pragma: no cover - disclosure over failure
+        out["chain_audit"] = {"error": repr(e)[:200]}
     if expected_ts is not None:
         out["order_exact"] = bool(order_ok)
     if audit:

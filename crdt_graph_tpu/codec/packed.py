@@ -216,6 +216,19 @@ def derive_slot_hints(arrs: dict) -> dict:
     - ``dup_row``   i8[N]: 1 iff an earlier array row carries the same
       add timestamp (the kernel's first-array-row-wins duplicate
       election, formerly a win-frame readback gather).
+    - ``win_row``   i32[N]: the canonical SOURCE ROW per slot — entry k
+      is the first array row whose rank is k (IPOS32 when slot k+1 is
+      unused), i.e. exactly what the kernel's winner scatter-min
+      computed on device.  With it the fused resolution assembles the
+      whole ``win`` frame elementwise (concat + sentinels), so the one
+      remaining resolution-stage M-wide memory op leaves the trace
+      (round 7; utils/chainaudit.py budget).
+    - ``parent_row`` i32[N]: the canonical row of the op's RESOLVED
+      parent (``win_row`` composed with the parent resolution), -1 when
+      the parent is the root or unresolved.  Rides the node-frame plane
+      as the second-hop index: the parent's materialised path/depth
+      re-derive from its source row instead of a separate ``[M, D+1]``
+      gather through ``pslot`` (ops/fused_resolve.py ``plane_rows2``).
 
     Slot encodings depend on the array CAPACITY (NULL = cap+1): any
     re-pad must recompute them (``pad_arrays`` does).
@@ -253,16 +266,31 @@ def derive_slot_hints(arrs: dict) -> dict:
     # winner election, which pack's first-add-per-ts dict also matches)
     dup = np.zeros(n, np.int8)
     rows = np.nonzero(has_rank)[0]
+    first_of_rank = np.full(n + 1, n, np.int64)
     if rows.size:
-        first_of_rank = np.full(n + 1, n, np.int64)
         # reversed so the SMALLEST row with each rank wins the store
         first_of_rank[rank[rows][::-1]] = rows[::-1]
         dup[rows] = (rows != first_of_rank[rank[rows]]).astype(np.int8)
+    # winner frame, host-elected: slot k+1's canonical row (IPOS32 when
+    # unused) — the kernel's scatter-min, done once at ingest
+    IPOS32 = 2**31 - 1
+    win_row = np.where(first_of_rank[:n] < n, first_of_rank[:n],
+                       IPOS32).astype(np.int32)
+    # second-hop index: the parent's canonical row (-1 = root-level or
+    # unresolved — both read as a zeroed parent frame downstream, which
+    # is exactly what fp[ROOT] / fp[NULL] held)
+    p_slot = parent_sl >> 1
+    p_found = (parent_sl & 1).astype(bool)
+    real_parent = p_found & (p_slot >= 1) & (p_slot <= n)
+    pr = first_of_rank[np.clip(p_slot - 1, 0, n)]
+    parent_row = np.where(real_parent & (pr < n), pr, -1).astype(np.int32)
     return {"parent_sl": parent_sl, "at_sl": at_sl,
-            "anchor_psl": anchor_psl, "dup_row": dup}
+            "anchor_psl": anchor_psl, "dup_row": dup,
+            "win_row": win_row, "parent_row": parent_row}
 
 
-SLOT_HINT_COLS = ("parent_sl", "at_sl", "anchor_psl", "dup_row")
+SLOT_HINT_COLS = ("parent_sl", "at_sl", "anchor_psl", "dup_row",
+                  "win_row", "parent_row")
 
 
 def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:

@@ -107,6 +107,184 @@ if HAVE_PALLAS:
         )(starts, idx_pad, limbs_pad)
 
 
+HOP_J = 1152       # 2nd-hop locality bound, rounded to a lane tile
+SPAN2 = SPAN + 2 * HOP_J   # 2nd-hop window rows per tile
+
+
+if HAVE_PALLAS:
+    def _kernel2(starts_ref, idx_ref, plane_hbm, out_ref, out2_ref,
+                 scr_a, scr_b, sem_a, sem_b, *, hop_col, r_rows):
+        """Two dependent bounded-span row gathers in one VMEM pass: the
+        first hop exactly as :func:`_kernel`; the hop index then
+        re-packs from the gathered row's ``hop_col`` limbs IN REGISTER
+        and drives a second one-hot contraction over a wider window
+        whose start derives from the first (the HOP_J locality bound
+        the wrapper verified)."""
+        i = pl.program_id(0)
+        r0 = starts_ref[i] * 128
+        rb = starts_ref[pl.num_programs(0) + i] * 128
+        ca = pltpu.make_async_copy(
+            plane_hbm.at[pl.ds(r0, SPAN), :], scr_a, sem_a)
+        ca.start()
+        cb = pltpu.make_async_copy(
+            plane_hbm.at[pl.ds(rb, SPAN2), :], scr_b, sem_b)
+        cb.start()
+        ca.wait()
+        off = idx_ref[...] - r0            # [TILE] ∈ [0, SPAN)
+        onehot = (off[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (TILE, SPAN), 1)).astype(jnp.float32)
+        vals_a = scr_a[...].astype(jnp.float32)        # [SPAN, C4]
+        g = jax.lax.dot_general(
+            onehot, vals_a, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+        out_ref[...] = g
+        # hop index from the gathered row's limb pair (i32 bit-exact:
+        # the hop column stores row indices < 2^31, or -1)
+        hop = (g[:, 4 * hop_col + 1] << 16) | g[:, 4 * hop_col]
+        valid2 = hop >= 0
+        i2 = jnp.clip(hop, jnp.int32(0), jnp.int32(r_rows - 1))
+        off2 = i2 - rb
+        cb.wait()
+        onehot2 = ((off2[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (TILE, SPAN2), 1)) &
+            valid2[:, None]).astype(jnp.float32)
+        vals_b = scr_b[...].astype(jnp.float32)        # [SPAN2, C4]
+        out2_ref[...] = jax.lax.dot_general(
+            onehot2, vals_b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+
+    def _pallas_call2(limbs_pad, idx_pad, starts2, c4, tiles, hop_col,
+                      r_rows, interpret):
+        import functools
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(tiles,),
+            in_specs=[
+                pl.BlockSpec((TILE,), lambda i, starts: (i,)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((TILE, c4), lambda i, starts: (i, 0)),
+                pl.BlockSpec((TILE, c4), lambda i, starts: (i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((SPAN, c4), jnp.int32),
+                pltpu.VMEM((SPAN2, c4), jnp.int32),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_kernel2, hop_col=hop_col, r_rows=r_rows),
+            out_shape=[
+                jax.ShapeDtypeStruct((tiles * TILE, c4), jnp.int32),
+                jax.ShapeDtypeStruct((tiles * TILE, c4), jnp.int32),
+            ],
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts2, idx_pad, limbs_pad)
+
+
+def _lax_rows2(plane: jax.Array, idx: jax.Array, hop_col: int):
+    """Reference semantics of the 2-hop sweep: ``g = plane[idx]``, then
+    ``g2 = plane[clip(hop, 0, R-1)]`` where ``hop = g[:, hop_col]``,
+    ZEROED where the hop is negative (no parent row)."""
+    g = _lax_rows(plane, idx)
+    hop = g[:, hop_col]
+    i2 = jnp.clip(hop, 0, plane.shape[0] - 1).astype(jnp.int32)
+    g2 = jnp.where((hop >= 0)[:, None], _lax_rows(plane, i2), 0)
+    return g, g2
+
+
+def plane_rows2(plane: jax.Array, idx: jax.Array, hop_col: int,
+                use_pallas: bool | None = None,
+                interpret: bool = False):
+    """The 2-hop node-frame sweep (round 7 resolution superop):
+    ``g = plane[idx]`` and ``g2 = plane[clip(g[:, hop_col], 0, R-1)]``
+    (zeroed rows where the hop is negative), with BOTH dependent
+    gathers in one pallas VMEM pass on TPU.
+
+    The second window's start derives from the first (no data-dependent
+    prefetch): legal iff the hop column is LOCALLY bounded —
+    ``|plane[j, hop_col] - j| <= HOP_J - 128`` for every row whose hop
+    is nonnegative (elementwise, checked in-trace).  A violating batch
+    takes the single-hop pallas sweep + a lax second gather via
+    ``lax.cond`` — fallback speed, never correctness.  Bit-identity
+    incl. the fallback split is pinned by tests/test_fused_resolve.py.
+    """
+    r, c = plane.shape
+    t = idx.shape[0]
+    c4 = 4 * c
+    if use_pallas and os.environ.get("GRAFT_PALLAS_INTERPRET") == "1":
+        interpret = True
+    if use_pallas is None:
+        use_pallas = HAVE_PALLAS and not interpret and \
+            jax.default_backend() == "tpu" and \
+            os.environ.get("GRAFT_NO_PALLAS") != "1"
+    if not (use_pallas or interpret) or not HAVE_PALLAS or \
+            plane.dtype != jnp.int64 or c4 > MAX_LANES:
+        return _lax_rows2(plane, idx, hop_col)
+    from ..utils import hostenv
+    if not hostenv.flag_on("GRAFT_FUSED_SUPEROP"):
+        # kill-switch for the 2-hop kernel ALONE: the first hop keeps
+        # the validated round-6 single-hop sweep, the second is the lax
+        # gather — so a superop problem on a live chip can be disabled
+        # without also giving up the host winner-election/parent_row
+        # resolution (GRAFT_FUSED_RESOLVE gates those)
+        g = plane_rows(plane, idx, use_pallas=use_pallas,
+                       interpret=interpret)
+        hop = g[:, hop_col]
+        i2 = jnp.clip(hop, 0, r - 1).astype(jnp.int32)
+        g2 = jnp.where((hop >= 0)[:, None], _lax_rows(plane, i2), 0)
+        return g, g2
+
+    tiles = -(-t // TILE)
+    t_pad = tiles * TILE
+    idx_pad = jnp.pad(idx.astype(jnp.int32), (0, t_pad - t), mode="edge")
+    by_tile = idx_pad.reshape(tiles, TILE)
+    starts = jnp.min(by_tile, axis=1) // 128
+    span_ok = jnp.all(jnp.max(by_tile, axis=1) - starts * 128 <
+                      jnp.int32(SPAN))
+    # hop locality: every nonnegative hop stays within HOP_J - 128 of
+    # its own plane row, so window B = [128·startA - HOP_J, ...+SPAN2)
+    # covers every reachable hop (start floors eat up to 127 rows)
+    hops = plane[:, hop_col]
+    rows_iota = jnp.arange(r, dtype=jnp.int64)
+    hop_ok = jnp.all((hops < 0) |
+                     (jnp.abs(hops - rows_iota) <= HOP_J - 128))
+    starts2 = jnp.maximum(starts - HOP_J // 128, 0)
+    both = jnp.concatenate([starts, starts2])
+
+    def _pallas2(_):
+        limbs = jnp.stack(
+            [((plane >> s) & 0xFFFF).astype(jnp.int32)
+             for s in (0, 16, 32, 48)], axis=-1).reshape(r, c4)
+        row_pad = SPAN2 + (-r) % 8
+        limbs_pad = jnp.pad(limbs, ((0, row_pad), (0, 0)))
+        with jaxcompat.enable_x64(False):
+            o1, o2 = _pallas_call2(limbs_pad, idx_pad, both, c4, tiles,
+                                   hop_col, r, interpret)
+
+        def _repack(o):
+            v = o[:t].astype(jnp.int64).reshape(t, c, 4)
+            return (v[:, :, 0] | (v[:, :, 1] << 16) |
+                    (v[:, :, 2] << 32) | (v[:, :, 3] << 48))
+        return _repack(o1), _repack(o2)
+
+    def _hop1(_):
+        # hop locality violated (or fragmented): first hop keeps its
+        # own bounded-span pallas sweep, second hop is the lax gather
+        g = plane_rows(plane, idx, use_pallas=True, interpret=interpret)
+        hop = g[:, hop_col]
+        i2 = jnp.clip(hop, 0, r - 1).astype(jnp.int32)
+        g2 = jnp.where((hop >= 0)[:, None], _lax_rows(plane, i2), 0)
+        return g, g2
+
+    return lax.cond(span_ok & hop_ok, _pallas2, _hop1, None)
+
+
 def plane_rows(plane: jax.Array, idx: jax.Array,
                use_pallas: bool | None = None,
                interpret: bool = False) -> jax.Array:

@@ -125,6 +125,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -168,6 +169,42 @@ def _env_cap(name: str, default: int) -> int:
 
 S_CAP_DEFAULT = 1 << 16   # crowded-sibling sort width (merge._finish)
 R_CAP_DEFAULT = 1 << 15   # run-pipeline compact width (merge._finish)
+# round-7 second compact level: chain-dominated production logs have a
+# few dozen contested rows / a few hundred runs, so the static 64k/32k
+# widths above overshoot by ~3 orders of magnitude — a nested tiny
+# branch (same construction, smaller cap) takes the common case; the
+# r6 caps stay as the middle level (XLA-CPU sorts and the unrolled
+# binary searches both scale with the static width)
+S_CAP2_DEFAULT = 1 << 12
+R_CAP2_DEFAULT = 1 << 12
+
+
+def _fused_flag(name: str) -> bool:
+    """Trace-time kill-switch for one round-7 fusion (default ON).
+
+    - ``GRAFT_FUSED_RESOLVE``: host-elected winner frame (``win_row``)
+      + second-hop parent frame (``parent_row``) replace the winner
+      scatter-min and the ``[M, D+1]`` parent-row gather on the vouched
+      fused path.
+    - ``GRAFT_FUSED_TAIL``: structural tail cuts shared by every
+      backend — scatter-free run starts (searchsorted over the sorted
+      run ids), scatter-free crowded-row compaction, the static
+      ``visible_order ≡ order`` identity + single-weight rank pipeline
+      under the no-deletes promise, and the conditional grandvalid
+      status gather.
+    - ``GRAFT_FUSED_SUPEROP``: the two dependent node-frame gathers
+      ride ONE pallas 2-hop bounded-span sweep on TPU
+      (ops/fused_resolve.plane_rows2).
+    - ``GRAFT_FUSED_SCAN``: the tour/weight prefix sums ride ONE pallas
+      sequential-grid scan on TPU (ops/tour_scan).
+
+    ``=0`` restores the round-6 trace for that piece (the A/B's B leg,
+    scripts/probe_fusedab.py runs all four together).  Same trace-time
+    caveats as :func:`_env_cap` (logged on every retrace; parse+log
+    shared with ops/fused_resolve via utils.hostenv.flag_on —
+    GRAFT_FUSED_SUPEROP is consumed there)."""
+    from ..utils import hostenv
+    return hostenv.flag_on(name)
 
 
 def _pack_gather_on() -> bool:
@@ -185,13 +222,10 @@ def _pack_gather_on() -> bool:
     ``GRAFT_PACK_GATHER=0`` remains the one-command B leg of that A/B,
     scripts/probe_packab.py).  Bit-identity of the two layouts is
     pinned by tests/test_merge_kernel.py either way.  Same trace-time
-    caveats as _env_cap (logged on every retrace)."""
-    import logging
-    import os
-    on = os.environ.get("GRAFT_PACK_GATHER", "1").lower() not in \
-        ("0", "off", "")
-    logging.getLogger(__name__).info("trace-time GRAFT_PACK_GATHER=%d", on)
-    return on
+    caveats as _env_cap (logged on every retrace; parse+log shared via
+    utils.hostenv.flag_on)."""
+    from ..utils import hostenv
+    return hostenv.flag_on("GRAFT_PACK_GATHER")
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -426,6 +460,18 @@ def _plane_rows(plane: jax.Array, idx: jax.Array,
     return fused_resolve.plane_rows(plane, idx, use_pallas=use_pallas)
 
 
+def _plane_rows2(plane: jax.Array, idx: jax.Array, hop_col: int,
+                 use_pallas) -> Tuple[jax.Array, jax.Array]:
+    """The 2-hop node-frame sweep: ``g = plane[idx]`` and
+    ``g2 = plane[clip(g[:, hop_col], 0, R-1)]`` — the round-7
+    resolution superop (ops/fused_resolve.plane_rows2): one pallas
+    VMEM-tiled pass on TPU for both dependent gathers, the two lax
+    gathers elsewhere, bit-identical either way."""
+    from . import fused_resolve
+    return fused_resolve.plane_rows2(plane, idx, hop_col,
+                                     use_pallas=use_pallas)
+
+
 def _resolve_sorted(ops: Dict[str, jax.Array]):
     """The full SORTED+JOIN resolution: the 10-tuple interface from raw
     op columns, hint-free.  The whole-array kernel's fallback branch and
@@ -656,12 +702,18 @@ def _materialize(ops: Dict[str, jax.Array],
         has_rank = is_real_add & (rank >= 0) & (rank < N)
         op_slot_r = jnp.where(has_rank, rank + 1, NULL).astype(jnp.int32)
         # duplicate election: host-precomputed first-array-row-wins flag
-        # (the win frame's readback gather leaves the trace; the
-        # scatter-min below stays — _finish still gathers the node frame
-        # through the winner row)
-        row_idx = jnp.arange(N, dtype=jnp.int32)
-        win = jnp.full(M, IPOS, jnp.int32).at[
-            jnp.where(has_rank, op_slot_r, M)].min(row_idx, mode="drop")
+        if "win_row" in ops and _fused_flag("GRAFT_FUSED_RESOLVE"):
+            # winner frame host-elected too (codec.packed win_row): the
+            # whole resolution stage is elementwise — zero M-wide
+            # memory ops (round 7; the scatter-min was the last one)
+            pad = jnp.full(1, IPOS, jnp.int32)
+            win = jnp.concatenate(
+                [pad, ops["win_row"].astype(jnp.int32), pad])
+        else:
+            row_idx = jnp.arange(N, dtype=jnp.int32)
+            win = jnp.full(M, IPOS, jnp.int32).at[
+                jnp.where(has_rank, op_slot_r, M)].min(row_idx,
+                                                       mode="drop")
         op_is_dup_r = ops["dup_row"].astype(bool) & has_rank
         is_node_slot_r = win < jnp.int32(N)
         pf = ops["parent_sl"].astype(jnp.int32)
@@ -788,6 +840,11 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # below from the same plane gather as every other node column, so
     # the whole node-frame construction is ONE M-wide sweep.
     fused = node_ts is None
+    # round-7 structural tail cuts (one trace-time switch for all of
+    # them — scatter-free run starts/compaction, tiny compact levels,
+    # single-weight rank pipeline, conditional grandvalid statuses)
+    tail_on = _fused_flag("GRAFT_FUSED_TAIL")
+    single_w = no_deletes and tail_on
 
     # ---- 3. Node-table construction from the SELECTED assignment —
     # shared across all branches, outside any cond, and SCATTER-FREE:
@@ -807,6 +864,22 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # at Delete rows only (step 7), where the fused column IS the target.
     pa = _pack_u((pp_slot << 1) | pp_found, (at_slot << 1) | at_found)
     extra = []
+    # 2nd-hop parent frame (round 7): with the host-shipped parent_row
+    # column riding the plane, the parent's materialised path/depth
+    # re-derive elementwise from its SOURCE ROW (second gather of the
+    # same plane), and both hops fuse into one pallas superop
+    # (plane_rows2).  DEVICE-ONLY: the trick trades a narrow [M, D+1]
+    # gather for a second full-plane hop — one fused VMEM pass on TPU
+    # (op COUNT is what the chain budget prices there), but ~2x the
+    # random bytes on the lax/CPU path, where bytes are what cost
+    # (measured: stage 2 of the CPU fallback bench regressed 62 →
+    # 202 ms under the 2-hop lax fallback); the lax trace keeps the
+    # round-6 fp-plane gather through pslot.
+    dev_pallas = use_pallas is True or (
+        use_pallas is None and jax.default_backend() == "tpu" and
+        os.environ.get("GRAFT_NO_PALLAS") != "1")
+    fused2 = fused and "parent_row" in ops and _pack_gather_on() and \
+        dev_pallas and _fused_flag("GRAFT_FUSED_RESOLVE")
     if fused:
         # hi = the anchor row's own parent resolution (what the sibling
         # check read as pslot[aslot]); lo = batch position; plus the raw
@@ -814,11 +887,21 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         # one plane row-gather instead of their own M-wide passes
         ap_src = _pack_u(ops["anchor_psl"].astype(jnp.int32), pos)
         extra = [ap_src[:, None], ts[:, None]]
+        if fused2:
+            extra = extra + [ops["parent_row"].astype(jnp.int64)[:, None]]
+    # parent_row's plane column: always the LAST extra (derived, not
+    # hardcoded — a wrong hop column would rebuild parent frames from
+    # whatever column sits there, silently corrupting validity)
+    HOP_COL = 2 + len(extra) - 1
+    g2 = None
     if _pack_gather_on():
-        # all nsr-indexed gathers ride one [N, D+2(+2)] i64 plane row
+        # all nsr-indexed gathers ride one [N, D+2(+2|+3)] i64 plane row
         plane = jnp.concatenate(
             [dsv_src[:, None], pa[:, None]] + extra + [paths], axis=1)
-        g = _plane_rows(plane, nsr, use_pallas)
+        if fused2:
+            g, g2 = _plane_rows2(plane, nsr, HOP_COL, use_pallas)
+        else:
+            g = _plane_rows(plane, nsr, use_pallas)
         k = 2 + len(extra)
         dsv, pa_g, claimed_raw = g[:, 0], g[:, 1], g[:, k:]
         if fused:
@@ -875,7 +958,26 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # match the parent's materialised path (what "descending the path"
     # validates in the reference, Internal/Node.elm:138-163), the anchor
     # must be a sibling (same parent), depths must chain.
-    if _pack_gather_on():
+    if fused2:
+        # parent frame from the plane's second hop: the parent slot's
+        # materialised path is its claimed path with its own timestamp
+        # placed at depth-1 — exactly how fp is built per slot below —
+        # re-derived here from the parent's SOURCE ROW (g2).  Slots
+        # whose parent is the root, unresolved, or absent read a zeroed
+        # frame, matching what fp[ROOT]/fp[NULL]/unused rows held (the
+        # prefix/depth checks are gated by pfound either way).
+        pvalid = is_node_slot & (g[:, HOP_COL] >= 0)
+        par_depth = jnp.where(pvalid,
+                              (g2[:, 0] >> 33).astype(jnp.int32), 0)
+        pc = jnp.where(pvalid[:, None], g2[:, k:], 0)
+        pc_h, pc_l = _split_u(pc)
+        pts_h, pts_l = _split_u(jnp.where(pvalid, g2[:, 3],
+                                          jnp.int64(0)))
+        put_p = (cols == jnp.clip(par_depth - 1, 0, D - 1)[:, None]) & \
+            (par_depth[:, None] > 0)
+        par_h = jnp.where(put_p, pts_h[:, None], pc_h)
+        par_l = jnp.where(put_p, pts_l[:, None], pc_l)
+    elif _pack_gather_on():
         # parent path plane + parent depth in one [M, D+1] i64 row
         # gather through pslot; the fp repack below (the kernel's output
         # plane, line ~1229) is the same _pack_u expression, so XLA CSEs
@@ -1118,36 +1220,80 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
         n_crowded = cpos[M - 1] + 1
 
-        def br_small(_):
-            at = jnp.where(crowded, cpos, S_CAP)
-            if _pack_gather_on():
-                # the three compaction columns share ONE index: one
-                # [S_CAP, 2] multi-column scatter (key+group bit-packed
-                # — skey ≤ NULL < 2^30; IPOS padding unpacks to a key
-                # that still sorts after every real row, and padding
-                # detection stays ``neg == IPOS`` as before)
-                vals = jnp.stack(
-                    [(skey << 1) | ggrp.astype(jnp.int32), neg_slot],
-                    axis=-1)
-                kgn = jnp.full((S_CAP, 2), IPOS, jnp.int32).at[at].set(
-                    vals, mode="drop", unique_indices=True)
-                kp = kgn[:, 0] >> 1
-                gg = (kgn[:, 0] & 1).astype(jnp.int8)
-                neg = kgn[:, 1]
-            else:
-                kp = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                    skey, mode="drop", unique_indices=True)
-                gg = jnp.zeros(S_CAP, jnp.int8).at[at].set(
-                    ggrp, mode="drop", unique_indices=True)
-                neg = jnp.full(S_CAP, IPOS, jnp.int32).at[at].set(
-                    neg_slot, mode="drop", unique_indices=True)
-            sib, fc = _sib_links(kp, gg, neg)
-            # singleton children: the parent's whole child list
-            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
-            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
-                       ].set(jnp.where(single_v < M, single_v, -1),
-                             mode="drop", unique_indices=True)
-            return sib, fc
+        def _br_compact(cap):
+            """The compact sibling branch at static width ``cap``: the
+            links are identical for ANY cap ≥ n_crowded (padding rows
+            sort last and drop), so nested caps are pure speed tiers —
+            XLA-CPU sort time and the unrolled binary search both scale
+            with the static width."""
+            def br(_):
+                if tail_on:
+                    # scatter-free compaction (round 7): ``cpos`` is a
+                    # nondecreasing ±1-step cumsum, so the k-th crowded
+                    # row is the first index where it reaches k — a
+                    # binary search per compact slot (cap-wide, log M
+                    # unrolled hops: compact-stage cost under the
+                    # width-weighted model) followed by one
+                    # compact-width gather, instead of the M-wide-index
+                    # [cap, 2] scatter (XLA-CPU serializes scatters —
+                    # the same op was also a top cost of the CPU
+                    # fallback bench)
+                    ks = jnp.arange(cap, dtype=cpos.dtype)
+                    src = jnp.searchsorted(
+                        cpos, ks, side="left",
+                        method="scan_unrolled").astype(jnp.int32)
+                    valid_k = ks < n_crowded
+                    srcc = jnp.minimum(src, M - 1)
+                    if _pack_gather_on():
+                        # one [cap, 2] row gather (key+group bit-packed
+                        # — skey ≤ NULL < 2^30); padding detection
+                        # stays ``neg == IPOS`` as before
+                        vals = jnp.stack(
+                            [(skey << 1) | ggrp.astype(jnp.int32),
+                             neg_slot], axis=-1)[srcc]
+                        kp = jnp.where(valid_k, vals[:, 0] >> 1, IPOS)
+                        gg = jnp.where(valid_k, vals[:, 0] & 1,
+                                       0).astype(jnp.int8)
+                        neg = jnp.where(valid_k, vals[:, 1], IPOS)
+                    else:
+                        kp = jnp.where(valid_k, skey[srcc], IPOS)
+                        gg = jnp.where(valid_k, ggrp[srcc],
+                                       0).astype(jnp.int8)
+                        neg = jnp.where(valid_k, neg_slot[srcc], IPOS)
+                else:
+                    at = jnp.where(crowded, cpos, cap)
+                    if _pack_gather_on():
+                        # the three compaction columns share ONE index:
+                        # one [cap, 2] multi-column scatter (key+group
+                        # bit-packed — skey ≤ NULL < 2^30; IPOS padding
+                        # unpacks to a key that still sorts after every
+                        # real row, and padding detection stays
+                        # ``neg == IPOS`` as before)
+                        vals = jnp.stack(
+                            [(skey << 1) | ggrp.astype(jnp.int32),
+                             neg_slot], axis=-1)
+                        kgn = jnp.full((cap, 2), IPOS,
+                                       jnp.int32).at[at].set(
+                            vals, mode="drop", unique_indices=True)
+                        kp = kgn[:, 0] >> 1
+                        gg = (kgn[:, 0] & 1).astype(jnp.int8)
+                        neg = kgn[:, 1]
+                    else:
+                        kp = jnp.full(cap, IPOS, jnp.int32).at[at].set(
+                            skey, mode="drop", unique_indices=True)
+                        gg = jnp.zeros(cap, jnp.int8).at[at].set(
+                            ggrp, mode="drop", unique_indices=True)
+                        neg = jnp.full(cap, IPOS, jnp.int32).at[at].set(
+                            neg_slot, mode="drop", unique_indices=True)
+                sib, fc = _sib_links(kp, gg, neg)
+                # singleton children: the parent's whole child list
+                single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
+                fc = fc.at[jnp.where(in_forest & ~crowded,
+                                     order_parent, M)
+                           ].set(jnp.where(single_v < M, single_v, -1),
+                                 mode="drop", unique_indices=True)
+                return sib, fc
+            return br
 
         def br_single(_):
             """ALL crowded rows share one (parent, group) key — the flat
@@ -1179,11 +1325,19 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             (jnp.min(cgrp) == jnp.max(jnp.where(
                 crowded, ggrp.astype(jnp.int32), -1)))
 
+        S_CAP2 = _env_cap("GRAFT_S_CAP2", S_CAP2_DEFAULT)
+
+        def _compact_dispatch(_):
+            full = lambda __: _sib_links(skey, ggrp, neg_slot)  # noqa: E731
+            mid = lambda __: lax.cond(              # noqa: E731
+                n_crowded <= S_CAP, _br_compact(S_CAP), full, None)
+            if tail_on and S_CAP2 < S_CAP:
+                return lax.cond(n_crowded <= S_CAP2,
+                                _br_compact(S_CAP2), mid, None)
+            return mid(None)
+
         sib_next, first_child = lax.cond(
-            one_group, br_single,
-            lambda _: lax.cond(
-                n_crowded <= S_CAP, br_small,
-                lambda __: _sib_links(skey, ggrp, neg_slot), None), None)
+            one_group, br_single, _compact_dispatch, None)
     # the root never sits in a sibling list (its exit token is the chain
     # terminal below)
     sib_next = sib_next.at[ROOT].set(-1)
@@ -1251,17 +1405,6 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     loop_ = succ == tok
     same_run = fwd | bwd | (loop_[:-1] & loop_[1:])
     boundary = jnp.concatenate([jnp.ones(1, bool), ~same_run])
-    rid = lax.cumsum(boundary.astype(jnp.int32)) - 1     # run id per token
-    # one unique-set scatter for the starts (each run has exactly one
-    # start token); runs TILE the token axis contiguously (rid is a
-    # boundary cumsum), so each run ends where the next begins — run_e
-    # derives elementwise instead of paying a second M-wide scatter
-    run_s = jnp.full(T, IPOS, jnp.int32).at[
-        jnp.where(boundary, rid, T)].set(tok, mode="drop",
-                                         unique_indices=True)
-    next_s = jnp.concatenate([run_s[1:], jnp.full(1, IPOS, jnp.int32)])
-    run_e = jnp.where(run_s == IPOS, 0,
-                      jnp.where(next_s == IPOS, T - 1, next_s - 1))
 
     # Token weights and their exclusive prefix sums.  Only ENTER tokens
     # (the first M) carry weight — exit tokens count nothing — so the
@@ -1272,13 +1415,42 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # is the merged self-loop block across M-1/M, which is terminal and
     # zero-weight — its window reads are clamped and then zeroed by
     # ``run_terminal`` in _expand, so the clamp never mis-weights it.
-    # both weight columns ride ONE batched scan (two lanes of a [2, M]
-    # cumsum price like one M-wide pass, not two)
-    cs = lax.cumsum(jnp.stack([exists.astype(jnp.int32),
-                               visible.astype(jnp.int32)]), axis=1)
+    #
+    # Round 7 (GRAFT_FUSED_TAIL): under the static no-deletes promise
+    # ``visible ≡ exists``, so the visible lane of the whole rank
+    # pipeline is the doc lane — one weight lane, single-column Wyllie,
+    # a [4, M] expansion plane, and ``visible_order`` aliasing ``order``
+    # (one fewer M-wide scatter).  With deletes both lanes ride as
+    # before.  The run-id prefix sum and the weight lanes fuse into ONE
+    # pallas sequential-grid scan on TPU (ops/tour_scan, T = 2M tokens +
+    # Kw·M weights in the same sweep); elsewhere they are the same lax
+    # cumsums as round 6 — bit-identical (tests/test_tour_scan.py).
+    w_lanes = jnp.stack(
+        [exists.astype(jnp.int32)] if single_w else
+        [exists.astype(jnp.int32), visible.astype(jnp.int32)])
+    from . import tour_scan
+    rid_incl, w_incl = tour_scan.prefix_sums(
+        boundary.astype(jnp.int32), w_lanes,
+        use_pallas if _fused_flag("GRAFT_FUSED_SCAN") else False)
+    rid = rid_incl - 1                   # run id per token
     z1 = jnp.zeros(1, jnp.int32)
-    cse_doc = jnp.concatenate([z1, cs[0]])
-    cse_vis = jnp.concatenate([z1, cs[1]])
+    cse_doc = jnp.concatenate([z1, w_incl[0]])
+    cse_vis = cse_doc if single_w else jnp.concatenate([z1, w_incl[1]])
+
+    def _runs_full():
+        """T-wide run starts via the unique-set scatter (each run has
+        exactly one start token); runs TILE the token axis contiguously
+        (rid is a boundary cumsum), so each run ends where the next
+        begins — run_e derives elementwise instead of paying a second
+        M-wide scatter."""
+        run_s = jnp.full(T, IPOS, jnp.int32).at[
+            jnp.where(boundary, rid, T)].set(tok, mode="drop",
+                                             unique_indices=True)
+        next_s = jnp.concatenate([run_s[1:],
+                                  jnp.full(1, IPOS, jnp.int32)])
+        run_e = jnp.where(run_s == IPOS, 0,
+                          jnp.where(next_s == IPOS, T - 1, next_s - 1))
+        return run_s, run_e
 
     def _expand(run_s_w, run_e_w):
         """Per-run chain data at width ``run_s_w.shape[0]`` → Wyllie →
@@ -1304,33 +1476,46 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         run_s_c = jnp.minimum(run_s_w, M)
         run_e1_c = jnp.minimum(run_e_w + 1, M)
         # per-run total weight; zero-weight absorbing (terminal) runs
-        # make the Wyllie telescoping exact once pointers collapse
+        # make the Wyllie telescoping exact once pointers collapse.
+        # single_w: the visible lane IS the doc lane (no-deletes), so
+        # the doubling loop and the expansion plane carry one column
         a0 = jnp.where(run_terminal, 0, cse_doc[run_e1_c] - cse_doc[run_s_c])
-        b0 = jnp.where(run_terminal, 0, cse_vis[run_e1_c] - cse_vis[run_s_c])
+        b0 = None if single_w else \
+            jnp.where(run_terminal, 0, cse_vis[run_e1_c] - cse_vis[run_s_c])
 
         def wy_cond(state):
-            _, _, _, live, i = state
+            live, i = state[-2], state[-1]
             return live & (i < _ceil_log2(w) + 1)
 
         def wy_body(state):
+            if single_w:
+                a, p, _, i = state
+                p2 = p[p]
+                return a + a[p], p2, jnp.any(p2 != p), i + 1
             a, b, p, _, i = state
             a2 = a + a[p]
             b2 = b + b[p]
             p2 = p[p]
             return a2, b2, p2, jnp.any(p2 != p), i + 1
 
-        a_doc, a_vis, _, _, _ = lax.while_loop(
-            wy_cond, wy_body,
-            (a0, b0, jnp.minimum(run_next, w - 1), jnp.array(True),
-             jnp.int32(0)))
+        p0 = jnp.minimum(run_next, w - 1)
+        if single_w:
+            a_doc, _, _, _ = lax.while_loop(
+                wy_cond, wy_body, (a0, p0, jnp.array(True), jnp.int32(0)))
+            a_vis = None
+        else:
+            a_doc, a_vis, _, _, _ = lax.while_loop(
+                wy_cond, wy_body,
+                (a0, b0, p0, jnp.array(True), jnp.int32(0)))
         # rid[:M] < M, so the value plane never needs more than the
         # first M runs — slice full-width (w = 2M) fallback sources down
         out = min(w, M)
         per_run = jnp.stack([
             run_fwd[:out].astype(jnp.int32),
             cse_doc[run_s_c[:out]], cse_doc[run_e1_c[:out]], a_doc[:out],
+        ] + ([] if single_w else [
             cse_vis[run_s_c[:out]], cse_vis[run_e1_c[:out]], a_vis[:out],
-        ])
+        ]))
         return mono_gather.monotone_gather(per_run, rid[:M],
                                            use_pallas=use_pallas)
 
@@ -1344,8 +1529,47 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # branches produce the same [7, M] expansion.
     R_CAP = _env_cap("GRAFT_R_CAP", R_CAP_DEFAULT)
     if R_CAP >= T:
-        ex = _expand(run_s, run_e)
+        ex = _expand(*_runs_full())
+    elif tail_on:
+        # scatter-free run starts on the compact path (round 7): rid is
+        # a nondecreasing boundary cumsum hitting every id 0..n_runs-1,
+        # so run k's first token is a binary search — R_CAP-wide,
+        # log T unrolled hops (compact-stage cost, width-weighted
+        # model) — and the T-wide-index scatter survives only in the
+        # fragmented-tour fallback branch (XLA-CPU serializes scatters;
+        # this one was the single most expensive op of the CPU
+        # fallback bench)
+        n_runs = rid[T - 1] + 1
+
+        def _compact(cap):
+            """Scatter-free run pipeline at static width ``cap`` —
+            identical expansion for any cap ≥ n_runs (unused run ids
+            read IPOS starts exactly as the scatter version's defaults),
+            so nested caps are pure speed tiers."""
+            def br(_):
+                ks = jnp.arange(cap, dtype=jnp.int32)
+                ss = jnp.searchsorted(
+                    rid, ks, side="left",
+                    method="scan_unrolled").astype(jnp.int32)
+                run_s_w = jnp.where(ks < n_runs, ss, IPOS)
+                next_s = jnp.concatenate([run_s_w[1:],
+                                          jnp.full(1, IPOS, jnp.int32)])
+                run_e_w = jnp.where(run_s_w == IPOS, 0,
+                                    jnp.where(next_s == IPOS, T - 1,
+                                              next_s - 1))
+                return _expand(run_s_w, run_e_w)
+            return br
+
+        R_CAP2 = _env_cap("GRAFT_R_CAP2", R_CAP2_DEFAULT)
+        mid = lambda _: lax.cond(               # noqa: E731
+            n_runs <= R_CAP, _compact(R_CAP),
+            lambda __: _expand(*_runs_full()), None)
+        if R_CAP2 < R_CAP:
+            ex = lax.cond(n_runs <= R_CAP2, _compact(R_CAP2), mid, None)
+        else:
+            ex = mid(None)
     else:
+        run_s, run_e = _runs_full()
         n_runs = rid[T - 1] + 1
         ex = lax.cond(
             n_runs <= R_CAP,
@@ -1369,15 +1593,21 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         return e_tok[ROOT] - e_tok
 
     doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
-    vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
 
     doc_index = jnp.where(exists, doc_dense, IPOS)
     order = jnp.full(M, NULL, jnp.int32).at[
         jnp.where(exists, doc_dense, M)].set(
             slot_ids, mode="drop", unique_indices=True)
-    visible_order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(visible, vis_dense, M)].set(
-            slot_ids, mode="drop", unique_indices=True)
+    if single_w:
+        # no deletes ⇒ visible ≡ exists ⇒ the visible order IS the
+        # document order, statically — the second rank expansion and
+        # its M-wide scatter drop out of the trace
+        visible_order = order
+    else:
+        vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
+        visible_order = jnp.full(M, NULL, jnp.int32).at[
+            jnp.where(visible, vis_dense, M)].set(
+                slot_ids, mode="drop", unique_indices=True)
     if probe is not None:
         acc = acc + _probe_sum(doc_index, order, visible_order)
         if probe == 7:
@@ -1386,30 +1616,62 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # ---- 13. Sequential-parity statuses per op.  Per-slot facts pack
     # into one int32 so each op needs two gathers (meta + anc_del), not
     # five separate ones.
-    meta = (valid.astype(jnp.int32)
-            | (parent_ok.astype(jnp.int32) << 1)
-            | (valid[pslot].astype(jnp.int32) << 2))
     status = jnp.full(N, PAD, jnp.int8)
-    # adds
     a_slot = op_slot
-    a_meta = meta[a_slot]
-    a_valid = (a_meta & 1) != 0
-    a_parent_ok = (a_meta & 2) != 0
-    a_grandvalid = (a_meta & 4) != 0     # valid[pslot[a_slot]]
-    # statically no ancestor delete under the no-deletes promise: the
-    # anc_del frame is a constant there, so the gather would be a dead
-    # M-wide op the chain budget still counts at trace level
-    a_absorbed = False if no_deletes else \
-        a_valid & (anc_del[a_slot] < pos)
     # an Add with ts 0 collides with the branch-head sentinel: the reference
     # finds an existing child and reports AlreadyApplied
     a_sentinel = ts <= 0
-    a_status = jnp.where(
-        a_sentinel | (a_valid & (op_is_dup | a_absorbed)), ALREADY_APPLIED,
-        jnp.where(a_valid, APPLIED,
-                  jnp.where(a_parent_ok & a_grandvalid, NOT_FOUND,
-                            INVALID_PATH)))
-    status = jnp.where(is_add, a_status.astype(jnp.int8), status)
+    if no_deletes and tail_on:
+        # grandvalid (valid[pslot], the NOT_FOUND/INVALID_PATH split) is
+        # only read for INVALID non-sentinel adds — on the production
+        # all-valid path that M-wide gather pair moves inside a cond
+        # the fast path never takes (round 7); the always-paid cost is
+        # the one per-op meta gather below
+        meta_s = valid.astype(jnp.int32) | \
+            (parent_ok.astype(jnp.int32) << 1)
+        a_meta = meta_s[a_slot]
+        a_valid = (a_meta & 1) != 0
+        a_parent_ok = (a_meta & 2) != 0
+
+        def _status_slow(_):
+            a_grand = valid[pslot][a_slot]   # valid[pslot[a_slot]]
+            return jnp.where(
+                a_sentinel | (a_valid & op_is_dup), ALREADY_APPLIED,
+                jnp.where(a_valid, APPLIED,
+                          jnp.where(a_parent_ok & a_grand, NOT_FOUND,
+                                    INVALID_PATH))).astype(jnp.int8)
+
+        def _status_fast(_):
+            # every non-sentinel add is valid here, so only the
+            # duplicate/sentinel split remains — same formula with the
+            # never-selected invalid arm dropped
+            return jnp.where(a_sentinel | (a_valid & op_is_dup),
+                             ALREADY_APPLIED,
+                             APPLIED).astype(jnp.int8)
+
+        need_grand = jnp.any(is_add & ~a_sentinel & ~a_valid)
+        a_status = lax.cond(need_grand, _status_slow, _status_fast, None)
+        status = jnp.where(is_add, a_status, status)
+    else:
+        meta = (valid.astype(jnp.int32)
+                | (parent_ok.astype(jnp.int32) << 1)
+                | (valid[pslot].astype(jnp.int32) << 2))
+        a_meta = meta[a_slot]
+        a_valid = (a_meta & 1) != 0
+        a_parent_ok = (a_meta & 2) != 0
+        a_grandvalid = (a_meta & 4) != 0     # valid[pslot[a_slot]]
+        # statically no ancestor delete under the no-deletes promise:
+        # the anc_del frame is a constant there, so the gather would be
+        # a dead M-wide op the chain budget still counts at trace level
+        a_absorbed = False if no_deletes else \
+            a_valid & (anc_del[a_slot] < pos)
+        a_status = jnp.where(
+            a_sentinel | (a_valid & (op_is_dup | a_absorbed)),
+            ALREADY_APPLIED,
+            jnp.where(a_valid, APPLIED,
+                      jnp.where(a_parent_ok & a_grandvalid, NOT_FOUND,
+                                INVALID_PATH)))
+        status = jnp.where(is_add, a_status.astype(jnp.int8), status)
     # deletes (statically absent under the no-deletes promise)
     if not no_deletes:
         d_parent_ok = (depth == 1) | \

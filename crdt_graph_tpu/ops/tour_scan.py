@@ -1,0 +1,155 @@
+"""Pallas TPU kernel: fused sequential prefix sums for the tour tail
+(round 7; ISSUE 3 tentpole).
+
+The merge kernel's rank pipeline needs independent 0/1-integer prefix
+sums that XLA emits as separate M-wide serialized scan passes: the
+run-id cumsum over the T = 2M Euler-tour boundary bits and the (1 or
+2)-lane node-weight cumsums over the M slots (ops/merge.py step 12).
+This kernel computes ALL of them in ONE pass: the lanes concatenate
+into a single token stream (each segment padded to a tile multiple, so
+segment starts are STATIC tile indices), and a sequential grid sweeps
+it with an SMEM carry — TPU grid steps execute in order, so per-tile
+partial sums turn into exact global prefixes, and the carry RESETS at
+each segment's (static) first tile, keeping the segments' scans
+independent.
+
+The in-tile prefix runs on the MXU as one triangular one-hot matmul
+per (8, 256) tile: every addend is 0/1 and a tile holds ≤ 2048 of
+them, so the f32 contraction is exact (< 2^24); the int32 carry and
+row offsets are added after the cast, keeping exactness for prefixes
+up to 2^31.
+
+``prefix_sums`` is the wrapper: the Mosaic kernel on TPU backends, the
+same lax cumsums as round 6 elsewhere — bit-identical either way
+(tests/test_tour_scan.py).  ``GRAFT_NO_PALLAS=1`` and
+``GRAFT_FUSED_SCAN=0`` (read by the caller, ops/merge.py) both force
+the lax path.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import jaxcompat
+
+TILE = 2048      # stream elements per grid step, as an (8, 256) block
+ROWS, LANES = 8, 256
+
+try:  # pallas is TPU/Mosaic; keep importable on bare CPU builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _lax_prefix(boundary: jax.Array,
+                weights: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Reference semantics: the round-6 lax scans (the run-id cumsum
+    over T tokens, the batched weight cumsum over M)."""
+    return lax.cumsum(boundary), lax.cumsum(weights, axis=1)
+
+
+if HAVE_PALLAS:
+    def _kernel(seg_starts, x_ref, o_ref, carry):
+        """One (8, 256) tile: in-tile inclusive prefix + carry."""
+        i = pl.program_id(0)
+        # carry resets at each segment's static first tile
+        reset = (i == seg_starts[0])
+        for s in seg_starts[1:]:
+            reset = reset | (i == s)
+
+        @pl.when(reset)
+        def _init():
+            carry[0] = jnp.int32(0)
+
+        x = x_ref[...].astype(jnp.float32)            # [8, 256] of 0/1
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0) <=
+               jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+               ).astype(jnp.float32)
+        row_pref = jax.lax.dot_general(
+            x, tri, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)      # [8, 256] incl.
+        totals = row_pref[:, LANES - 1:LANES]         # [8, 1]
+        # exclusive prefix over the 8 row totals (strict lower-tri)
+        strict = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, ROWS), 1) <
+                  jax.lax.broadcasted_iota(jnp.int32, (ROWS, ROWS), 0)
+                  ).astype(jnp.float32)
+        offs = jax.lax.dot_general(
+            strict, totals, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)      # [8, 1]
+        pref = (row_pref + offs).astype(jnp.int32)
+        o_ref[...] = pref + carry[0]
+        carry[0] = carry[0] + jnp.sum(x_ref[...])     # + tile total
+
+    def _pallas_call(stream2d, seg_starts, tiles, interpret):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(tiles,),
+            in_specs=[pl.BlockSpec((ROWS, LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((ROWS, LANES), lambda i: (i, 0)),
+            scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        )
+        # the "scan" in the name is LOAD-BEARING for the cost model:
+        # utils/chainaudit bills sequential-scan kernels by their full
+        # stream length (every element is serially swept), not by the
+        # output row count like the bounded-span gather kernels
+        return pl.pallas_call(
+            functools.partial(_kernel, seg_starts),
+            out_shape=jax.ShapeDtypeStruct(stream2d.shape, jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+            name="tour_scan_prefix",
+        )(stream2d)
+
+
+def _pad_tile(x: jax.Array) -> jax.Array:
+    n = x.shape[0]
+    return jnp.pad(x, (0, -n % TILE))
+
+
+def prefix_sums(boundary: jax.Array, weights: jax.Array,
+                use_pallas: bool | None = None,
+                interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Inclusive prefix sums ``(cumsum(boundary), cumsum(weights, 1))``
+    for i32 ``boundary[T]`` and i32 ``weights[Kw, M]`` with every
+    element in {0, 1}.  One fused pallas sweep on TPU backends; the lax
+    cumsums elsewhere.  ``use_pallas`` follows the mono_gather
+    convention (None = auto: Mosaic on TPU, lax elsewhere)."""
+    t = boundary.shape[0]
+    kw, m = weights.shape
+    if use_pallas and os.environ.get("GRAFT_PALLAS_INTERPRET") == "1":
+        interpret = True
+    if use_pallas is None:
+        use_pallas = HAVE_PALLAS and not interpret and \
+            jax.default_backend() == "tpu" and \
+            os.environ.get("GRAFT_NO_PALLAS") != "1"
+    if not (use_pallas or interpret) or not HAVE_PALLAS or \
+            kw > 3 or t < TILE:
+        return _lax_prefix(boundary, weights)
+
+    segs = [_pad_tile(boundary.astype(jnp.int32))] + \
+        [_pad_tile(weights[k].astype(jnp.int32)) for k in range(kw)]
+    starts, b = [], 0
+    for s in segs:
+        starts.append(b // TILE)
+        b += s.shape[0]
+    stream = jnp.concatenate(segs)
+    tiles = stream.shape[0] // TILE
+    with jaxcompat.enable_x64(False):
+        out = _pallas_call(stream.reshape(tiles * ROWS, LANES),
+                           tuple(starts), tiles, interpret)
+    out = out.reshape(-1)
+    ob = out[:t]
+    t_pad = segs[0].shape[0]
+    m_pad = segs[1].shape[0]
+    ow = jnp.stack([out[t_pad + k * m_pad:t_pad + k * m_pad + m]
+                    for k in range(kw)])
+    return ob, ow

@@ -64,6 +64,38 @@ def global_device_mesh(n_ops: int = 1) -> Mesh:
     return Mesh(grid, (DOCS_AXIS, OPS_AXIS))
 
 
+def allgather_scalars(tag: str, local: Dict[int, int],
+                      timeout_ms: int = 120_000) -> Dict[int, int]:
+    """All-gather a small ``{index: value}`` host dict across the fleet
+    via the coordination service's key-value store.
+
+    The CONTROL plane, not the data plane: this jaxlib's CPU backend
+    cannot reshard device arrays across processes
+    (``multihost_utils.process_allgather`` dies with "Multiprocess
+    computations aren't implemented on the CPU backend"), but the
+    coordination client every ``jax.distributed.initialize`` runtime
+    already carries moves host scalars fine — which is all the fleet
+    verification sweeps exchange (per-doc fingerprints).  Keys are
+    namespaced by ``tag``; call with a fresh tag per exchange (the KV
+    store has no delete).  Raises on timeout — a dead peer must fail
+    the gather loudly, not hang it."""
+    import json as _json
+
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    if client is None:
+        return dict(local)
+    pid = jax.process_index()
+    client.key_value_set(f"{tag}/{pid}",
+                         _json.dumps(sorted(local.items())))
+    out: Dict[int, int] = {}
+    for p in range(jax.process_count()):
+        got = client.blocking_key_value_get(f"{tag}/{p}", timeout_ms)
+        out.update({int(k): int(v) for k, v in _json.loads(got)})
+    return out
+
+
 def host_local_docs_to_global(ops: Dict[str, np.ndarray],
                               mesh: Mesh) -> Dict[str, jax.Array]:
     """Assemble a fleet-wide batch from per-host document shards.

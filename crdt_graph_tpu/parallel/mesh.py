@@ -153,8 +153,18 @@ def batched_materialize(ops: Dict[str, np.ndarray], mesh: Mesh,
 
     kernel = _batched_kernel_hinted if exhaustive_hints else _batched_kernel
 
+    def _placed(v, sharding):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            # cross-process global array (host_local_docs_to_global
+            # already placed it): a device_put here would RESHARD
+            # through the data plane, which the CPU multi-controller
+            # backend cannot do — the computation follows the array's
+            # existing docs-axis sharding instead
+            return v
+        return jax.device_put(v, sharding)
+
     def run():
-        device_ops = {k: jax.device_put(v, NamedSharding(mesh, spec_for(v)))
+        device_ops = {k: _placed(v, NamedSharding(mesh, spec_for(v)))
                       for k, v in ops.items()}
         return kernel(device_ops)
 

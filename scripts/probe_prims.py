@@ -166,11 +166,41 @@ def main():
                     0, N - 1)
     row("pallas span_row_gather [N,5] i64", lambda p, i: fp(
         _span_rows(p, i)), plane5, diag)
+    # ---- round-7 fused shapes (rows 32-34): the exact kernels the
+    # ≤10-op chain ships (docs/TPU_PROFILE.md §8) — price each against
+    # its unfused equivalent above to confirm one pallas superop costs
+    # ~one serialized pass.
+    plane6h = jnp.concatenate(
+        [jnp.tile(i64N[:, None], (1, 4)),
+         jnp.clip(jnp.arange(N, dtype=jnp.int64) + (idxN % 97) - 48,
+                  0, N - 1)[:, None],          # near-diagonal hop col
+         i64N[:, None]], axis=1)
+    row("pallas plane_rows2 2hop [N,6] i64", lambda p, i: fp(
+        _span_rows2(p, i)), plane6h, diag)
+    bnd = jnp.asarray(rng.integers(0, 2, T, dtype=np.int32))
+    wts = jnp.asarray(rng.integers(0, 2, (1, N + 2), dtype=np.int32))
+    row("pallas tour_scan T+M prefix", lambda b, w: fp(
+        _tour_scan(b, w)), bnd[:2 * (N + 2)], wts)
+    ridq = jnp.sort(jnp.asarray(
+        rng.integers(0, 4096, T, dtype=np.int32)))
+    row("searchsorted 4k in T unrolled", lambda r, k: fp(
+        jnp.searchsorted(r, k, side="left", method="scan_unrolled")),
+        ridq, jnp.arange(4096, dtype=jnp.int32))
 
 
 def _span_rows(p, i):
     from crdt_graph_tpu.ops import fused_resolve
     return fused_resolve.plane_rows(p, i)
+
+
+def _span_rows2(p, i):
+    from crdt_graph_tpu.ops import fused_resolve
+    return fused_resolve.plane_rows2(p, i, 4)
+
+
+def _tour_scan(b, w):
+    from crdt_graph_tpu.ops import tour_scan
+    return tour_scan.prefix_sums(b, w)
 
 
 def _chain_elementwise(a, k):

@@ -1,23 +1,31 @@
 #!/bin/bash
-# Post-round-6 on-chip batch for the NEXT tunnel grant, strictly serial
-# in one process chain (two clients deadlock the grant).  Round 6
-# restructured the kernel to the ≤16 M-wide-op chain (fused resolution:
-# derived slot hints + one node-frame plane sweep + pack-gather ON by
-# default — utils/chainaudit.py pins the count in CI); this batch's job
-# is to CONFIRM the model on chip.  Order = value per granted minute
-# (r5 windows were 42/8/10 min):
-#   1. headline + stage profile with the fused kernel (judge-facing
-#      number; the auditor models 16 x ~6 ms ≈ 96 ms + RTT — the first
-#      run that can land <120 ms, docs/TPU_PROFILE.md §6)
-#   2. probe_prims rows 17-31: the staged layout A/Bs (17-24
-#      stacked/planar, 25-27 per-HLO-overhead-vs-width, 28-31 the
-#      round-6 fused shapes incl. the pallas span_row_gather leg)
-#   3. pack-gather A/B (GRAFT_PACK_GATHER now defaults ON; packab runs
+# Post-round-7 on-chip batch for the NEXT tunnel grant, strictly serial
+# in one process chain (two clients deadlock the grant).  Round 7 cut
+# the audited chain 16 -> 9 under the width-weighted budget (fused
+# 2-hop resolution superop, tour-scan kernel, scatter-free run starts /
+# compaction — utils/chainaudit.py pins ≤10 device / ≤12 lax in CI;
+# docs/TPU_PROFILE.md §8).  This batch's job is to CONFIRM the model on
+# chip in one pass.  Order = value per granted minute:
+#   1. headline + stage profile with the r7 kernel (judge-facing
+#      number; the auditor models 9 ops ≈ 54 ms + RTT — the first run
+#      that can land p50_minus_rtt < 100 ms)
+#   2. NEW-KERNEL A/B (probe_fusedab: all GRAFT_FUSED_* off = the r6
+#      kernel vs default-on = r7, 3 repeats per leg, one verdict line —
+#      the on-chip twin of the committed CPU artifact
+#      ABFUSED_r07_cpu.json; equivalent to re-running tpu_session
+#      phases 2+7 under both flag sets, in one command)
+#   3. probe_prims rows 17-34: the staged layout A/Bs (17-24
+#      stacked/planar, 25-27 per-HLO-overhead-vs-width — the cell that
+#      decides whether chainaudit's compact_risk_ms is real cost —
+#      28-31 the round-6 fused shapes, 32-34 the round-7 kernels:
+#      plane_rows2 2-hop, tour_scan, unrolled searchsorted)
+#   4. pack-gather A/B (GRAFT_PACK_GATHER stays default ON; packab runs
 #      both legs in subprocesses — the one-command A/B either way)
-#   4. full 8-config sweep (audit-gated publishing: tpu_session
+#   5. full 8-config sweep (audit-gated publishing: tpu_session
 #      quarantines any audit.ok:false row out of the headline stream),
-#      scale sweep, cap tuning (recompile-heavy — late on purpose)
-#   5. config-6 sub-cuts, longest-window-only
+#      scale sweep, cap tuning (recompile-heavy — late on purpose; the
+#      r7 caps add GRAFT_S_CAP2/GRAFT_R_CAP2 to the sweep space)
+#   6. config-6 sub-cuts, longest-window-only
 #
 # Usage: bash scripts/tpu_next_grant.sh [outdir]   (default /tmp)
 OUT=${1:-/tmp}
@@ -25,20 +33,25 @@ cd /root/repo
 {
   echo "=== tpu_session 0 2 7 $(date -u +%H:%M:%S) ==="
   timeout 1800 python scripts/tpu_session.py 0 2 7 \
-    >> "$OUT/tpu_round6.jsonl" 2>> "$OUT/tpu_round6.err"
-  echo "=== probe_prims from-row-16 (rows 17-31) $(date -u +%H:%M:%S) ==="
-  timeout 1200 python scripts/probe_prims.py 1000000 16 \
+    >> "$OUT/tpu_round7.jsonl" 2>> "$OUT/tpu_round7.err"
+  echo "=== probe_fusedab (r6 vs r7 kernel) $(date -u +%H:%M:%S) ==="
+  # ONE round (chip timing is stable; the interleaved multi-round mode
+  # exists for the noisy CPU box): 2 legs x 1200 s inner timeout +
+  # compile headroom — the outer bound must exceed the sum or a wedged
+  # leg 1 kills leg 2 mid-flight and the verdict line is never emitted
+  timeout 2700 python scripts/probe_fusedab.py 1000000 3 1 \
+    >> "$OUT/tpu_fusedab.jsonl" 2>> "$OUT/tpu_fusedab.err"
+  echo "=== probe_prims from-row-16 (rows 17-34) $(date -u +%H:%M:%S) ==="
+  timeout 1500 python scripts/probe_prims.py 1000000 16 \
     >> "$OUT/tpu_prims.txt" 2>&1
   echo "=== probe_packab $(date -u +%H:%M:%S) ==="
-  # 2 legs x 900 s inner timeout + startup/compile headroom: the outer
-  # bound must exceed the sum or a wedged leg 1 kills leg 2 mid-flight
   timeout 2100 python scripts/probe_packab.py 1000000 \
     >> "$OUT/tpu_packab.jsonl" 2>> "$OUT/tpu_packab.err"
   echo "=== tpu_session 4 5 6 $(date -u +%H:%M:%S) ==="
   timeout 2400 python scripts/tpu_session.py 4 5 6 \
-    >> "$OUT/tpu_round6.jsonl" 2>> "$OUT/tpu_round6.err"
+    >> "$OUT/tpu_round7.jsonl" 2>> "$OUT/tpu_round7.err"
   echo "=== tpu_session 8 (config6 subcuts) $(date -u +%H:%M:%S) ==="
   timeout 1500 python scripts/tpu_session.py 8 \
-    >> "$OUT/tpu_round6.jsonl" 2>> "$OUT/tpu_round6.err"
+    >> "$OUT/tpu_round7.jsonl" 2>> "$OUT/tpu_round7.err"
   echo "=== done $(date -u +%H:%M:%S) ==="
 } >> "$OUT/tpu_next_grant.log" 2>&1

@@ -79,6 +79,71 @@ def test_auto_falls_back_on_cpu():
     np.testing.assert_array_equal(got, np.asarray(plane)[np.asarray(idx)])
 
 
+# --- the 2-hop resolution superop (round 7) ---------------------------
+
+def _hop_plane(rng, r, c, hop_col, hop_spread, neg_frac=0.2):
+    """A plane whose ``hop_col`` holds a locally-bounded row index
+    (or -1 with probability ``neg_frac``) and full-range int64 payload
+    elsewhere."""
+    plane = rng.integers(0, 2**62, (r, c), dtype=np.int64)
+    hops = np.clip(np.arange(r) +
+                   rng.integers(-hop_spread, hop_spread + 1, r), 0, r - 1)
+    hops[rng.random(r) < neg_frac] = -1
+    plane[:, hop_col] = hops
+    return plane
+
+
+@pytest.mark.parametrize("t,r,c,hop_col", [
+    (700, 700, 3, 1), (1024, 4096, 5, 2), (2050, 2050, 6, 4)])
+def test_plane_rows2_interpret_matches_lax(t, r, c, hop_col):
+    rng = np.random.default_rng(t * 13 + r)
+    plane = _hop_plane(rng, r, c, hop_col, hop_spread=40)
+    idx = _bounded_span_idx(rng, t, r, spread=40)
+    want = fused_resolve._lax_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), hop_col)
+    got = fused_resolve.plane_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), hop_col,
+                                    interpret=True)
+    for gw, ww, tag in ((got[0], want[0], "hop1"),
+                        (got[1], want[1], "hop2")):
+        np.testing.assert_array_equal(np.asarray(gw), np.asarray(ww),
+                                      err_msg=tag)
+
+
+def test_plane_rows2_span_violation_falls_back():
+    """A shuffled FIRST-hop index routes the whole sweep through the
+    fallback branch — still exactly right."""
+    rng = np.random.default_rng(2)
+    r, t, c = 8192, 2048, 4
+    plane = _hop_plane(rng, r, c, 2, hop_spread=30)
+    idx = rng.permutation(r)[:t].astype(np.int32)
+    want = fused_resolve._lax_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), 2)
+    got = fused_resolve.plane_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), 2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_plane_rows2_hop_violation_falls_back():
+    """A far-jumping SECOND hop (|hop - row| > HOP_J) keeps the first
+    hop on the single-hop pallas sweep and takes the lax second gather
+    — still exactly right."""
+    rng = np.random.default_rng(3)
+    r, t, c = 8192, 2048, 4
+    plane = _hop_plane(rng, r, c, 2, hop_spread=30)
+    hops = np.asarray(plane[:, 2]).copy()
+    hops[100] = r - 1                      # one violating far hop
+    plane[:, 2] = hops
+    idx = _bounded_span_idx(rng, t, r, spread=40)
+    want = fused_resolve._lax_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), 2)
+    got = fused_resolve.plane_rows2(jnp.asarray(plane),
+                                    jnp.asarray(idx), 2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
 # --- full-merge parity: every sweep shape, pallas resolution green ----
 
 def _small_configs():
@@ -112,6 +177,35 @@ def test_full_merge_pallas_interpret_bit_identity(cid, monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(getattr(t_pal, f)), np.asarray(getattr(t_lax, f)),
             err_msg=f"config {cid} field {f}")
+
+
+@pytest.mark.parametrize("flags_on", [True, False])
+def test_fallback_path_order_exact_config5(flags_on, monkeypatch, request):
+    """ISSUE 3 acceptance: the config-5 closed-form order must hold on
+    the lax path both with the round-7 fusions on (their lax fallbacks)
+    and with every GRAFT_FUSED_* kill-switch thrown (the round-6
+    trace)."""
+    for f in ("GRAFT_FUSED_RESOLVE", "GRAFT_FUSED_TAIL",
+              "GRAFT_FUSED_SCAN", "GRAFT_FUSED_SUPEROP"):
+        if flags_on:
+            monkeypatch.delenv(f, raising=False)
+        else:
+            monkeypatch.setenv(f, "0")
+    # the flags are read at TRACE time under identical shapes/static
+    # args, so a cached trace from the other parametrization (or from
+    # earlier tests) would silently shadow this leg's flag state — and
+    # this leg's trace would poison later tests the same way
+    jax.clear_caches()
+    request.addfinalizer(jax.clear_caches)
+    n = 65_536
+    arrs = workloads.chain_workload(64, n)
+    t = view.to_host(merge.materialize(arrs, use_pallas=False,
+                                       hints="exhaustive"))
+    exp = workloads.chain_expected_ts(64, n)
+    seq = np.asarray(t.ts)[np.asarray(t.visible_order)]
+    seq = seq[:int(t.num_visible)]
+    assert int(t.num_visible) == n
+    np.testing.assert_array_equal(seq, exp)
 
 
 def test_full_merge_pallas_interpret_auto_mode(monkeypatch):

@@ -1,0 +1,91 @@
+"""Same-host relative perf pin (ISSUE 3 satellite).
+
+An absolute wall-clock assertion would flap with machine variance, so
+the pin is a RATIO: the full production merge's p50 against a fixed
+reference primitive — a [N, 6] int64 plane row-gather, the kernel's own
+dominant memory shape — measured back-to-back on the same host in the
+same process.  A ~2x kernel-side CPU regression (a re-added serialized
+scatter, a de-fused pass) roughly doubles the ratio and fails tier-1;
+a slow machine slows both sides and cancels.
+
+The tier-1 pin runs at 256k ops (compile + repeats in ~30 s on the
+2-core driver box); the 1M headline-scale variant is slow-marked.
+Measured round-7 ratio on the driver box: 1.3-2.0 at 256k (CPU
+backend, best-of-5 both sides).  The bound is 2x the observed max, so
+same-host regressions of the 2-3x class trip it while machine variance
+(which moves numerator and denominator together) does not.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from crdt_graph_tpu.bench import workloads  # noqa: E402
+from crdt_graph_tpu.ops import merge  # noqa: E402
+
+
+def _p50(fn, *args, repeats=5):
+    """Best-of-N: the minimum is the stablest same-host statistic under
+    CI noise (a contended repeat inflates mean/median, never the min),
+    and a structural regression shifts the minimum too."""
+    jax.block_until_ready(fn(*args))          # compile + warm
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def _ratio(n_ops: int) -> float:
+    arrs = workloads.chain_workload(64, n_ops)
+    dev = jax.device_put(arrs)
+
+    @jax.jit
+    def kernel(o):
+        # reductions over result fields so no stage can be DCE'd
+        t = merge._materialize(o, False, "exhaustive", True)
+        return jnp.sum(t.doc_index) + jnp.sum(t.status.astype(jnp.int32))
+
+    n = int(arrs["kind"].shape[0])
+    rng = np.random.default_rng(0)
+    plane = jax.device_put(
+        rng.integers(0, 2**60, (n, 6), dtype=np.int64))
+    idx = jax.device_put(rng.integers(0, n, n, dtype=np.int32))
+
+    @jax.jit
+    def reference(p, i):
+        # four DEPENDENT full-plane row gathers (each index derives from
+        # the previous gather's data, so XLA can neither elide nor
+        # overlap them): big enough that the ratio's denominator is not
+        # noise-dominated on a busy CI box
+        acc = jnp.int64(0)
+        idx = i
+        for _ in range(4):
+            g = p[idx]
+            acc = acc + jnp.sum(g)
+            idx = (idx + g[:, 0].astype(jnp.int32)) & (n - 1)
+        return acc
+
+    kernel_p50 = _p50(kernel, dev)
+    ref_p50 = max(_p50(reference, plane, idx), 1e-5)
+    return kernel_p50 / ref_p50
+
+
+def test_kernel_vs_reference_ratio_256k():
+    r = _ratio(262_144)
+    assert r < 4.0, f"merge/reference p50 ratio {r:.2f} (round-7 " \
+        "measured 1.3-2.0 on the driver box): a kernel-side CPU " \
+        "regression, not machine variance — both sides ran on this host"
+
+
+@pytest.mark.slow
+def test_kernel_vs_reference_ratio_1m():
+    r = _ratio(1_000_000)
+    assert r < 4.0, f"merge/reference p50 ratio {r:.2f} at 1M ops"

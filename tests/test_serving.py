@@ -315,8 +315,15 @@ def test_coalesced_pushes_match_sequential_document():
     try:
         engine.get("co")
         engine.scheduler.pause()
-        pairs = [submit_async(engine, "co", b) for b in bodies]
-        wait_queue_depth(engine, "co", len(bodies))
+        # enqueue ORDER is load-bearing here (first-arrival-wins dedup
+        # attributes the cross-delta duplicates to whichever request is
+        # earlier in the queue), so serialize the submits — five racing
+        # threads reach the queue in nondeterministic order and the
+        # expected counts below assume list order
+        pairs = []
+        for i, b in enumerate(bodies):
+            pairs.append(submit_async(engine, "co", b))
+            wait_queue_depth(engine, "co", i + 1)
         engine.scheduler.resume()
         for th, _ in pairs:
             th.join(30)

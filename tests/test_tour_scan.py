@@ -1,0 +1,71 @@
+"""The pallas fused prefix-sum sweep must equal the lax cumsums
+(ops/tour_scan.py) — interpret-mode Mosaic on CPU, every lane and
+padding shape, including the segment-carry resets between the token
+stream and the weight lanes (ISSUE 3 satellite: bit-identity for every
+new pallas kernel)."""
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from crdt_graph_tpu.ops import tour_scan  # noqa: E402
+
+
+def _check(boundary, weights):
+    want_b, want_w = tour_scan._lax_prefix(jnp.asarray(boundary),
+                                           jnp.asarray(weights))
+    got_b, got_w = tour_scan.prefix_sums(jnp.asarray(boundary),
+                                         jnp.asarray(weights),
+                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_b), np.asarray(want_b))
+    np.testing.assert_array_equal(np.asarray(got_w), np.asarray(want_w))
+
+
+@pytest.mark.parametrize("m,kw", [(2048, 1), (2048, 2), (5000, 1),
+                                  (5000, 2), (16384, 2), (33000, 1)])
+def test_interpret_matches_lax(m, kw):
+    """Random 0/1 lanes at T = 2M, tile-aligned and ragged sizes."""
+    rng = np.random.default_rng(m * 7 + kw)
+    boundary = rng.integers(0, 2, 2 * m).astype(np.int32)
+    weights = rng.integers(0, 2, (kw, m)).astype(np.int32)
+    _check(boundary, weights)
+
+
+def test_all_ones_and_all_zeros():
+    """Degenerate lanes: the carry chain must stay exact across every
+    tile (prefix reaches T > the in-tile matmul bound — exactness rides
+    the int32 carry, not the f32 contraction)."""
+    m = 9000
+    _check(np.ones(2 * m, np.int32), np.zeros((2, m), np.int32))
+    _check(np.zeros(2 * m, np.int32), np.ones((1, m), np.int32))
+
+
+def test_segment_isolation():
+    """A boundary lane ending mid-tile must not leak its carry into the
+    first weight lane (static segment resets)."""
+    m = 3000           # T = 6000: last boundary tile is half-padding
+    boundary = np.ones(2 * m, np.int32)
+    weights = np.zeros((2, m), np.int32)
+    weights[0, 0] = 1
+    got_b, got_w = tour_scan.prefix_sums(jnp.asarray(boundary),
+                                         jnp.asarray(weights),
+                                         interpret=True)
+    assert int(got_w[0, 0]) == 1 and int(got_w[0, -1]) == 1
+    assert int(got_w[1, -1]) == 0
+    assert int(got_b[-1]) == 2 * m
+
+
+def test_small_input_takes_lax_path():
+    """Below one tile the wrapper returns the lax scans outright."""
+    b = np.ones(64, np.int32)
+    w = np.ones((1, 32), np.int32)
+    got_b, got_w = tour_scan.prefix_sums(jnp.asarray(b), jnp.asarray(w),
+                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_b),
+                                  np.cumsum(b).astype(np.int32))
+    np.testing.assert_array_equal(np.asarray(got_w)[0],
+                                  np.cumsum(w[0]).astype(np.int32))
