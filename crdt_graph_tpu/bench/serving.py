@@ -6,7 +6,10 @@ W writer threads push randomized deltas to M documents through the
 scheduler while R reader threads hammer snapshot reads, and one
 bootstrap-size push lands mid-run to prove reads don't stall behind a
 big merge.  Reported: reader latency percentiles (the snapshot-isolation
-headline), commit latency, coalesce width, and scheduler span stats.
+headline), commit latency, coalesce width, scheduler span stats, and
+the flight-recorder counters (every bench commit leaves a traced record
+behind — obs/flight.py — so a pathological bench round ships its own
+post-mortem dump).
 
 Usage: ``python -m crdt_graph_tpu.bench.serving [docs] [seconds]``
 (defaults 4 docs, 5 s).  Emits one JSON line.
@@ -90,11 +93,26 @@ def run(n_docs: int = 4, seconds: float = 5.0, writers_per_doc: int = 4,
     for t in threads:
         t.start()
 
-    # mid-run bootstrap push: a big chain lands on doc 0 while readers run
+    # mid-run bootstrap push: a big chain lands on doc 0 while readers
+    # run; its named trace id is how the flight record for THIS push is
+    # found among the coalesced interactive traffic
     big, _, _ = _delta(99, 0, 0, bootstrap_ops)
     t0 = time.perf_counter()
-    engine.submit(doc_ids[0], json_codec.dumps(big))
+    engine.submit(doc_ids[0], json_codec.dumps(big),
+                  trace_id="bench-bootstrap-push")
     bootstrap_s = time.perf_counter() - t0
+    # grab the bootstrap commit's flight record NOW: it lands
+    # asynchronously just after the ticket resolves, and the bounded
+    # ring (default capacity 256) evicts it long before the run ends
+    # under interactive traffic
+    boot_rec = None
+    boot_deadline = time.perf_counter() + 10.0
+    while boot_rec is None and time.perf_counter() < boot_deadline:
+        boot_rec = next(
+            (r for r in engine.flight.records()
+             if "bench-bootstrap-push" in r.trace_ids), None)
+        if boot_rec is None:
+            time.sleep(0.05)
 
     while time.perf_counter() - t_start < seconds:
         time.sleep(0.05)
@@ -125,6 +143,16 @@ def run(n_docs: int = 4, seconds: float = 5.0, writers_per_doc: int = 4,
         "doc0_metrics": engine.get(doc_ids[0]).metrics(),
     }
     engine.close()
+    # after close the scheduler is joined: the recorder holds every
+    # commit.  Report its counters plus the bootstrap push's own
+    # record (stage breakdown + coalesce context for the headline
+    # bootstrap_commit_s number).
+    out["flight"] = engine.flight.stats()
+    if boot_rec is None:        # late-landing record: last-chance scan
+        boot_rec = next(
+            (r for r in engine.flight.records()
+             if "bench-bootstrap-push" in r.trace_ids), None)
+    out["bootstrap_record"] = boot_rec.to_json() if boot_rec else None
     return out
 
 

@@ -1,0 +1,340 @@
+"""Prometheus-style text exposition (``GET /metrics/prom``) and the
+strict mini-parser the tests and the smoke gate pin it with.
+
+One scrape surface merges the four telemetry sources that previously
+lived behind four different JSON shapes:
+
+- per-document store counters and gauges (``ServedDoc.metrics``);
+- the scheduler histograms WITH their bucket bounds (cumulative
+  ``_bucket{le=...}`` series, not just the JSON quantile summary);
+- the process-wide span registry (``utils.profiling.span``);
+- flight-recorder gauges and dump counters.
+
+Naming contract (validated by :func:`parse_text`): every family is
+``crdt_``-prefixed; counters end ``_total``; histograms expose
+``_bucket``/``_sum``/``_count`` with ascending ``le`` ending in
+``+Inf`` and cumulative counts.  The exposition format targets the
+text format v0.0.4 (the one every Prometheus scraper speaks).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape(v: str) -> str:
+    """Inverse of :func:`_escape` — one left-to-right pass so an
+    escaped backslash never re-triggers on the following char."""
+    return _UNESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Writer:
+    """Accumulates families in declaration order; one HELP/TYPE block
+    per family, samples appended under it."""
+
+    def __init__(self):
+        self._order: List[str] = []
+        self._fams: Dict[str, Tuple[str, str, List[str]]] = {}
+
+    def family(self, name: str, ftype: str, help_text: str) -> None:
+        if name not in self._fams:
+            self._order.append(name)
+            self._fams[name] = (ftype, help_text, [])
+
+    def sample(self, family: str, name: str, value: float,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        self._fams[family][2].append(
+            f"{name}{_fmt_labels(labels or {})} {_fmt_value(value)}")
+
+    def counter(self, name: str, help_text: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        self.family(name, "counter", help_text)
+        self.sample(name, name, value, labels)
+
+    def gauge(self, name: str, help_text: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
+        self.family(name, "gauge", help_text)
+        self.sample(name, name, value, labels)
+
+    def histogram(self, name: str, help_text: str,
+                  bounds: Sequence[float], counts: Sequence[int],
+                  total: int, total_sum: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        """``counts`` are PER-BUCKET (len(bounds)+1, last = overflow);
+        emitted cumulative with the standard ``le`` series."""
+        self.family(name, "histogram", help_text)
+        labels = labels or {}
+        cum = 0
+        for b, c in zip(bounds, counts):
+            cum += c
+            self.sample(name, f"{name}_bucket", cum,
+                        {**labels, "le": _fmt_value(b)})
+        self.sample(name, f"{name}_bucket", total,
+                    {**labels, "le": "+Inf"})
+        self.sample(name, f"{name}_sum", total_sum, labels)
+        self.sample(name, f"{name}_count", total, labels)
+
+    def render(self) -> str:
+        out: List[str] = []
+        for name in self._order:
+            ftype, help_text, samples = self._fams[name]
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {ftype}")
+            out.extend(samples)
+        return "\n".join(out) + "\n"
+
+
+def render_engine(engine) -> str:
+    """The unified scrape for a ``ServingEngine``: doc counters/gauges,
+    scheduler histograms with bucket bounds, scheduler counters, the
+    span registry, and flight gauges, one text body."""
+    from ..utils import profiling
+
+    w = _Writer()
+
+    # -- per-document store counters + gauges + histograms ---------------
+    doc_counters = (
+        ("crdt_doc_ops_merged_total", "Leaves merged into the document",
+         "ops_merged"),
+        ("crdt_doc_dup_absorbed_total", "Duplicate leaves absorbed",
+         "dup_absorbed"),
+        ("crdt_doc_batches_rejected_total",
+         "Deltas rejected for causality gaps", "batches_rejected"),
+        ("crdt_doc_admission_rejected_total",
+         "Writes shed at admission (429)", "admission_rejected"),
+        ("crdt_doc_chunks_launched_total",
+         "Kernel chunks launched", "chunks_launched"),
+    )
+    doc_gauges = (
+        ("crdt_doc_queue_depth", "Pending write tickets",
+         lambda d, s: len(d.queue)),
+        ("crdt_doc_queue_leaves", "Pending leaves across tickets",
+         lambda d, s: d.queue.pending_leaves()),
+        ("crdt_doc_snapshot_seq", "Published snapshot sequence",
+         lambda d, s: s.seq),
+        ("crdt_doc_snapshot_age_seconds",
+         "Age of the published snapshot", lambda d, s: s.age_s()),
+        ("crdt_doc_log_length", "Applied operation log length",
+         lambda d, s: s.log_length),
+        ("crdt_doc_visible_nodes", "Visible values in the snapshot",
+         lambda d, s: len(s.values)),
+    )
+    docs = engine.docs()
+    for name, help_text, attr in doc_counters:
+        w.family(name, "counter", help_text)
+        for d in docs:
+            w.sample(name, name, getattr(d, attr), {"doc": d.doc_id})
+    for name, help_text, fn in doc_gauges:
+        w.family(name, "gauge", help_text)
+        for d in docs:
+            w.sample(name, name, fn(d, d.snapshot_view()),
+                     {"doc": d.doc_id})
+    for name, help_text, attr in (
+            ("crdt_doc_commit_latency_ms",
+             "Commit latency per coalesced merge round", "commit_ms"),
+            ("crdt_doc_coalesce_width",
+             "Tickets fused per commit", "coalesce_width")):
+        w.family(name, "histogram", help_text)
+        for d in docs:
+            h = getattr(d, attr).export()
+            w.histogram(name, help_text, h["bounds"], h["counts"],
+                        h["count"], h["sum"], {"doc": d.doc_id})
+
+    # -- engine-wide scheduler counters ----------------------------------
+    for cname, val in sorted(engine.counters.snapshot().items()):
+        safe = re.sub(r"[^a-zA-Z0-9_]", "_", cname)
+        w.counter(f"crdt_scheduler_{safe}_total",
+                  f"Scheduler counter {cname}", val)
+
+    # -- span registry ---------------------------------------------------
+    spans = profiling.span_stats()
+    w.family("crdt_span_ms_total", "counter",
+             "Accumulated wall ms per span")
+    w.family("crdt_span_calls_total", "counter",
+             "Invocations per span")
+    w.family("crdt_span_max_ms", "gauge",
+             "Max single invocation ms per span")
+    for sname, s in sorted(spans.items()):
+        lbl = {"span": sname}
+        w.sample("crdt_span_ms_total", "crdt_span_ms_total",
+                 s["total_ms"], lbl)
+        w.sample("crdt_span_calls_total", "crdt_span_calls_total",
+                 s["count"], lbl)
+        w.sample("crdt_span_max_ms", "crdt_span_max_ms",
+                 s["max_ms"], lbl)
+
+    # -- flight recorder -------------------------------------------------
+    fs = engine.flight.stats()
+    w.gauge("crdt_flight_records", "Commit records in the ring",
+            fs["records"])
+    w.counter("crdt_flight_records_total", "Commit records ever",
+              fs["records_total"])
+    w.counter("crdt_flight_slo_breaches_total",
+              "Commits over the SLO threshold", fs["slo_breaches"])
+    w.counter("crdt_flight_audit_failures_total",
+              "Sampled chain audits with ok=false",
+              fs["audit_failures"])
+    w.counter("crdt_flight_errors_total",
+              "Commits resolved with an engine error", fs["errors"])
+    w.family("crdt_flight_dumps_total", "counter",
+             "Automatic + manual flight dumps by reason")
+    for reason, n in sorted(fs["dumps"].items()):
+        w.sample("crdt_flight_dumps_total", "crdt_flight_dumps_total",
+                 n, {"reason": reason})
+    w.gauge("crdt_flight_slo_ms", "Configured commit SLO threshold",
+            fs["slo_ms"])
+    w.gauge("crdt_flight_last_commit_ms",
+            "Latency of the most recent commit", fs["last_commit_ms"])
+    return w.render()
+
+
+class PromParseError(ValueError):
+    """The exposition violated the format or the naming contract."""
+
+
+def parse_text(text: str, require_prefix: str = "crdt_"
+               ) -> Dict[str, Dict[str, Any]]:
+    """Strict parse of the exposition text.
+
+    Returns ``{family: {"type": t, "help": h, "samples":
+    [(name, labels, value), ...]}}`` and raises
+    :class:`PromParseError` on: samples without a declared family,
+    counter families not ending ``_total``, histogram series missing
+    ``_bucket``/``_sum``/``_count``, non-cumulative buckets, a missing
+    ``+Inf`` bucket, ``_count`` != the ``+Inf`` bucket, or a family
+    outside ``require_prefix``.
+    """
+    fams: Dict[str, Dict[str, Any]] = {}
+    current: Optional[str] = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            name = parts[2]
+            fams.setdefault(name, {"type": None, "help": None,
+                                   "samples": []})
+            fams[name]["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            name, ftype = parts[2], parts[3].strip()
+            fams.setdefault(name, {"type": None, "help": None,
+                                   "samples": []})
+            fams[name]["type"] = ftype
+            current = name
+            if require_prefix and not name.startswith(require_prefix):
+                raise PromParseError(
+                    f"line {lineno}: family {name!r} outside the "
+                    f"{require_prefix!r} namespace")
+            if ftype == "counter" and not name.endswith("_total"):
+                raise PromParseError(
+                    f"line {lineno}: counter {name!r} must end _total")
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise PromParseError(f"line {lineno}: unparseable sample "
+                                 f"{line!r}")
+        sname, rawlabels, rawval = m.groups()
+        labels = {k: _unescape(v) for k, v in
+                  _LABEL_RE.findall(rawlabels)} if rawlabels else {}
+        value = float(rawval.replace("+Inf", "inf"))
+        fam = None
+        if current is not None and (
+                sname == current or (
+                    fams[current]["type"] == "histogram" and
+                    sname in (f"{current}_bucket", f"{current}_sum",
+                              f"{current}_count"))):
+            fam = current
+        if fam is None:
+            raise PromParseError(
+                f"line {lineno}: sample {sname!r} does not belong to "
+                f"the current family {current!r}")
+        if not _NAME_RE.match(sname):
+            raise PromParseError(f"line {lineno}: bad name {sname!r}")
+        fams[fam]["samples"].append((sname, labels, value))
+
+    for name, fam in fams.items():
+        if fam["type"] is None:
+            raise PromParseError(f"family {name!r} has no TYPE")
+        if fam["type"] == "histogram":
+            _check_histogram(name, fam["samples"])
+    return fams
+
+
+def _check_histogram(name: str,
+                     samples: List[Tuple[str, Dict[str, str], float]]
+                     ) -> None:
+    series: Dict[Tuple[Tuple[str, str], ...],
+                 Dict[str, Any]] = {}
+    for sname, labels, value in samples:
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        s = series.setdefault(key, {"buckets": [], "sum": None,
+                                    "count": None})
+        if sname == f"{name}_bucket":
+            s["buckets"].append((labels.get("le"), value))
+        elif sname == f"{name}_sum":
+            s["sum"] = value
+        elif sname == f"{name}_count":
+            s["count"] = value
+    for key, s in series.items():
+        if not s["buckets"]:
+            raise PromParseError(f"{name}{dict(key)}: no buckets")
+        les = [le for le, _ in s["buckets"]]
+        if les[-1] != "+Inf":
+            raise PromParseError(
+                f"{name}{dict(key)}: last bucket le={les[-1]!r}, "
+                "want +Inf")
+        bounds = [float(le.replace("+Inf", "inf")) for le in les]
+        if bounds != sorted(bounds):
+            raise PromParseError(f"{name}{dict(key)}: le not ascending")
+        counts = [v for _, v in s["buckets"]]
+        if counts != sorted(counts):
+            raise PromParseError(
+                f"{name}{dict(key)}: buckets not cumulative")
+        if s["count"] is None or s["sum"] is None:
+            raise PromParseError(
+                f"{name}{dict(key)}: missing _count or _sum")
+        if s["count"] != counts[-1]:
+            raise PromParseError(
+                f"{name}{dict(key)}: _count {s['count']} != +Inf "
+                f"bucket {counts[-1]}")

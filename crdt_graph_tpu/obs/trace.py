@@ -1,0 +1,114 @@
+"""Trace context: the per-request ``trace_id`` and the per-commit
+stage collector.
+
+Lifecycle (docs/OBSERVABILITY.md):
+
+1. **Mint at admission.**  The HTTP handler (service/http.py,
+   ``POST /docs/{id}/ops``) mints a ``trace_id`` — or adopts a
+   well-formed client-supplied ``X-Trace-Id`` header — before the body
+   is parsed, so even a 400/429 is attributable.  Embedded callers of
+   ``ServingEngine.submit`` get one minted for them.
+2. **Ride the ticket.**  The id is stored on the
+   :class:`~crdt_graph_tpu.serve.queue.WriteTicket` together with the
+   handler-thread parse time and the queue depth observed at admission.
+3. **Coalesce.**  The scheduler fuses every ticket pending on a
+   document into one commit; the commit's :class:`CommitTrace` carries
+   ALL member trace_ids — a coalesced batch is attributable to every
+   request it served, not just the first.
+4. **Record.**  After publish (or rejection/error) the trace becomes a
+   :class:`~crdt_graph_tpu.obs.flight.CommitRecord` in the flight
+   recorder, and the id is echoed to the client (response body +
+   ``X-Trace-Id`` header) so a user report can be joined against the
+   server-side record.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+# wire header for propagating / echoing the id (case-insensitive on
+# ingest; http.client normalizes)
+TRACE_HEADER = "X-Trace-Id"
+
+# accepted client-supplied ids: 8-64 url-safe chars (anything else is
+# re-minted — the id lands in filenames and label values)
+_TRACE_RE = re.compile(r"^[A-Za-z0-9_.-]{8,64}$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (collision-safe for a single
+    process's flight-recorder window)."""
+    return uuid.uuid4().hex[:16]
+
+
+def ensure_trace_id(candidate: Optional[str]) -> str:
+    """Adopt a well-formed client id, mint otherwise."""
+    if candidate and _TRACE_RE.match(candidate):
+        return candidate
+    return mint_trace_id()
+
+
+class CommitTrace:
+    """Mutable per-commit collector the scheduler fills as it works.
+
+    Created when a document's round is fused, finalized into a
+    :class:`~crdt_graph_tpu.obs.flight.CommitRecord` when the commit
+    resolves.  Scheduler-thread owned; never shared across threads
+    until handed to the recorder.
+    """
+
+    __slots__ = ("doc_id", "trace_ids", "n_tickets", "num_ops",
+                 "parse_ms", "queue_depth_admission", "stages_ms",
+                 "chunk_count", "applied_ops", "dup_ops", "outcome",
+                 "staleness_s", "total_ms", "error", "packed")
+
+    def __init__(self, doc_id: str, tickets) -> None:
+        self.doc_id = doc_id
+        self.trace_ids: Tuple[str, ...] = tuple(
+            t.trace_id for t in tickets if t.trace_id)
+        self.n_tickets = len(tickets)
+        self.num_ops = sum(t.n_leaves for t in tickets)
+        # parse happened per-ticket in the handler threads; the commit
+        # bills the sum (the work its batch caused), and admission depth
+        # is the deepest queue any member saw on entry
+        self.parse_ms = round(sum(t.parse_ms for t in tickets), 3)
+        self.queue_depth_admission = max(
+            (t.depth_at_admission for t in tickets), default=0)
+        self.stages_ms: Dict[str, float] = {}
+        self.chunk_count = 0
+        self.applied_ops = 0
+        self.dup_ops = 0
+        self.outcome = "pending"
+        self.staleness_s: Optional[float] = None
+        # (the published snapshot's seq + fingerprint are stamped by
+        # ServingEngine.record_commit straight off doc.snapshot_view())
+        self.total_ms = 0.0
+        self.error: Optional[str] = None
+        # the fused batch (NOT serialized): kept only so the sampled
+        # chain audit can trace its shapes after the commit resolves
+        self.packed = None
+
+    @contextlib.contextmanager
+    def stage(self, name: str, span_name: Optional[str] = None):
+        """Time a commit stage into this trace AND the process-wide
+        span registry (``serve.<name>`` unless overridden) — the
+        per-commit breakdown and the aggregate stay one measurement."""
+        from ..utils import profiling
+        t0 = time.perf_counter()
+        try:
+            with profiling.span(span_name or f"serve.{name}"):
+                yield
+        finally:
+            ms = (time.perf_counter() - t0) * 1e3
+            self.stages_ms[name] = round(
+                self.stages_ms.get(name, 0.0) + ms, 3)
+
+    def stage_breakdown(self) -> Dict[str, float]:
+        """parse + the scheduler stages, one dict (record schema's
+        ``stages_ms``)."""
+        out = {"parse": self.parse_ms}
+        out.update(self.stages_ms)
+        return out

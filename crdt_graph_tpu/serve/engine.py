@@ -28,6 +28,7 @@ so every client reads its own writes.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -37,6 +38,8 @@ from ..codec import json_codec
 from ..codec import packed as packed_mod
 from ..core import operation as op_mod
 from ..core.operation import Batch, Operation
+from ..obs import flight as flight_mod
+from ..obs import trace as trace_mod
 from ..oplog import PackedBatch
 from . import snapshot as snapshot_mod
 from .metrics import Counters, Histogram, LATENCY_BOUNDS_MS, WIDTH_BOUNDS
@@ -90,12 +93,16 @@ class ServedDoc:
 
     # -- snapshot publication (scheduler thread only) ---------------------
 
-    def publish(self) -> None:
+    def publish(self) -> float:
         """Derive and swap in the next snapshot from the just-committed
         tree.  Single writer (the scheduler), so ``seq`` is strictly
-        monotone; the attribute store is the linearization point."""
+        monotone; the attribute store is the linearization point.
+        Returns the OUTGOING snapshot's age — the read staleness this
+        publish just retired, stamped on the commit's flight record."""
+        staleness = self._snap.age_s()
         self._seq += 1
         self._snap = snapshot_mod.derive(self.doc_id, self._seq, self.tree)
+        return staleness
 
     def snapshot_view(self) -> snapshot_mod.DocSnapshot:
         """The current published snapshot (lock-free)."""
@@ -121,12 +128,16 @@ class ServedDoc:
             self.next_replica += 1
             return rid
 
-    def apply_body(self, body) -> Tuple[bool, Operation]:
+    def apply_body(self, body,
+                   trace_id: Optional[str] = None
+                   ) -> Tuple[bool, Operation]:
         """Document-compatible write entry: enqueue, await the commit.
         Raises :class:`QueueFull` under backpressure (the handler's 429)
         and decode errors immediately (400), exactly like the inline
-        path raised them."""
-        return self._engine.submit(self.doc_id, body)
+        path raised them.  ``trace_id``: the id minted at HTTP
+        admission (obs/trace.py); one is minted here for embedded
+        callers that pass none."""
+        return self._engine.submit(self.doc_id, body, trace_id=trace_id)
 
     def retry_after_s(self) -> int:
         """Drain-time estimate for the Retry-After header, from this
@@ -172,6 +183,7 @@ class ServingEngine:
                  cross_doc: bool = True,
                  wire_fast_bytes: int = WIRE_FAST_BYTES,
                  submit_timeout_s: float = 600.0,
+                 flight: Optional[flight_mod.FlightRecorder] = None,
                  start: bool = True):
         from .scheduler import MergeScheduler
         self._docs: Dict[str, ServedDoc] = {}
@@ -184,6 +196,12 @@ class ServingEngine:
         self.wire_fast_bytes = wire_fast_bytes
         self.submit_timeout_s = submit_timeout_s
         self.counters = Counters()
+        # the flight recorder is process-wide by default (like the span
+        # registry): every commit resolved by this engine lands one
+        # record, and dumps trigger on SLO breach / audit failure /
+        # engine error (obs/flight.py; docs/OBSERVABILITY.md)
+        self.flight = flight if flight is not None \
+            else flight_mod.get_default_recorder()
         self.scheduler = MergeScheduler(self)
         if start:
             self.scheduler.start()
@@ -229,11 +247,16 @@ class ServingEngine:
         p = native.parse_pack(body, max_depth=self._max_depth)
         return p, p.num_ops
 
-    def submit(self, doc_id: str, body) -> Tuple[bool, Operation]:
+    def submit(self, doc_id: str, body,
+               trace_id: Optional[str] = None) -> Tuple[bool, Operation]:
         """Parse, admit, and await the merge of one client delta.
         Returns ``(accepted, applied_ops)`` like ``Document.apply_body``;
         raises :class:`QueueFull` (→ 429) or :class:`SchedulerStopped`
-        (→ 503)."""
+        (→ 503).  ``trace_id`` (minted at HTTP admission, or here for
+        embedded callers) rides the ticket into the fused commit's
+        flight record."""
+        from ..utils import profiling
+        tid = trace_mod.ensure_trace_id(trace_id)
         doc = self.get(doc_id)
         # shed at the door BEFORE paying the parse: a saturated queue
         # must not cost a full native parse (up to max_body) per
@@ -242,8 +265,11 @@ class ServingEngine:
         if len(doc.queue) >= doc.queue.max_requests:
             doc.admission_rejected += 1
             raise QueueFull(doc_id, len(doc.queue), doc.retry_after_s())
-        packed, n = self._parse(body)
-        ticket = WriteTicket(packed, n)
+        t0 = time.perf_counter()
+        with profiling.span("serve.parse"):
+            packed, n = self._parse(body)
+        ticket = WriteTicket(packed, n, trace_id=tid,
+                             parse_ms=(time.perf_counter() - t0) * 1e3)
         sched = self.scheduler
         with sched.cond:
             if sched.stopped:
@@ -290,6 +316,58 @@ class ServingEngine:
         t.applied_count = 0
         t.applied_op = Batch(())
 
+    # -- flight recording (scheduler thread) ------------------------------
+
+    def record_commit(self, doc: ServedDoc,
+                      ct: trace_mod.CommitTrace) -> None:
+        """Finalize one commit's :class:`~crdt_graph_tpu.obs.trace.
+        CommitTrace` into the flight recorder: stamp the published
+        snapshot's seq + fingerprint, attach the sampled chain audit
+        every Nth commit, and let the recorder fire its dump triggers.
+        Never raises — observability must not take down the scheduler
+        (a failed audit sample is recorded, not propagated)."""
+        audit = None
+        if (ct.packed is not None and ct.outcome in
+                ("committed", "partial")
+                and self.flight.audit_due(ct.num_ops)):
+            from ..utils import chainaudit
+            # the make_jaxpr re-trace runs on the scheduler thread and
+            # stalls every queued commit while it does — bill it as a
+            # visible stage (record + span registry) so the recorder
+            # never injects hot-path latency it cannot itself see; it
+            # stays out of total_ms (tickets resolved before it started,
+            # so it is scheduler stall, not client-visible latency)
+            try:
+                with ct.stage("audit_sample"):
+                    audit = chainaudit.audit_packed_summary(ct.packed)
+            except Exception as e:   # noqa: BLE001 — tripwire sampling
+                # a failed SAMPLE is not an audit failure: record the
+                # error without an "ok" verdict (no dump trigger)
+                audit = {"sample_error": repr(e)}
+        try:
+            snap = doc.snapshot_view()
+            self.flight.record({
+                "doc_id": ct.doc_id,
+                "trace_ids": ct.trace_ids,
+                "outcome": ct.outcome,
+                "num_ops": ct.num_ops,
+                "applied_ops": ct.applied_ops,
+                "dup_ops": ct.dup_ops,
+                "coalesce_width": ct.n_tickets,
+                "chunk_count": ct.chunk_count,
+                "queue_depth_admission": ct.queue_depth_admission,
+                "stages_ms": ct.stage_breakdown(),
+                "total_ms": round(ct.total_ms, 3),
+                "staleness_s": None if ct.staleness_s is None
+                else round(ct.staleness_s, 4),
+                "snapshot_seq": snap.seq,
+                "fingerprint": snap.fingerprint(),
+                "audit": audit,
+                "error": ct.error,
+            })
+        except Exception:            # noqa: BLE001 — recorder boundary
+            self.counters.add("flight_record_errors")
+
     # -- lifecycle / observability ---------------------------------------
 
     def scheduler_metrics(self) -> Dict:
@@ -301,7 +379,19 @@ class ServingEngine:
         out["queue_depth_total"] = sum(
             len(d.queue) for d in self.docs())
         out["spans"] = profiling.span_stats("serve.")
+        out["flight"] = self.flight.stats()
         return out
+
+    def render_prom(self) -> str:
+        """The unified Prometheus-style exposition
+        (``GET /metrics/prom``; obs/prom.py)."""
+        from ..obs import prom
+        return prom.render_engine(self)
+
+    def debug_flight(self) -> Dict:
+        """The enriched flight-recorder view (``GET /debug/flight``):
+        recorder config + counters + the full commit-record ring."""
+        return self.flight.debug_view()
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop the scheduler and fail any unresolved tickets (503) —

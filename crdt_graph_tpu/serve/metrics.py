@@ -81,6 +81,22 @@ class Histogram:
                 "max": round(self._max, 3),
             }
 
+    def export(self) -> Dict[str, object]:
+        """Full-fidelity exposition: the bucket BOUNDS and per-bucket
+        counts (last entry = overflow past the top bound), plus exact
+        count/sum/max — what ``/metrics/prom`` renders as the
+        cumulative ``le`` series (obs/prom.py) and ``/debug/flight``
+        embeds, instead of the quantile summary that loses the
+        distribution."""
+        with self._lock:
+            return {
+                "bounds": list(self._bounds),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": round(self._sum, 3),
+                "max": round(self._max, 3),
+            }
+
 
 class Counters:
     """A named bag of monotonically increasing integers (thread-safe)."""

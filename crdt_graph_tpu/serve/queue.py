@@ -58,13 +58,22 @@ class WriteTicket:
 
     The handler thread fills ``packed``/``n_leaves`` and waits on
     ``done``; the scheduler fills the outcome fields and sets ``done``
-    only after the commit's snapshot is published."""
+    only after the commit's snapshot is published.
+
+    Trace context (obs/trace.py) rides the ticket: ``trace_id`` is the
+    id minted at HTTP admission (every commit record in the flight
+    recorder carries all member tickets' ids), ``parse_ms`` the
+    handler-thread wire-parse time this request cost, and
+    ``depth_at_admission`` the queue depth observed when the ticket was
+    accepted — together the per-request half of the commit's stage
+    breakdown."""
 
     __slots__ = ("packed", "n_leaves", "enqueued_at",
                  "done", "accepted", "applied_count", "applied_op",
-                 "error")
+                 "error", "trace_id", "parse_ms", "depth_at_admission")
 
-    def __init__(self, packed: PackedOps, n_leaves: int):
+    def __init__(self, packed: PackedOps, n_leaves: int,
+                 trace_id: str = "", parse_ms: float = 0.0):
         self.packed = packed
         self.n_leaves = n_leaves
         self.enqueued_at = time.monotonic()
@@ -73,6 +82,9 @@ class WriteTicket:
         self.applied_count = 0
         self.applied_op = None          # Operation echo, or None
         self.error: Optional[BaseException] = None
+        self.trace_id = trace_id
+        self.parse_ms = parse_ms
+        self.depth_at_admission = 0
 
     def wait(self, timeout: Optional[float]) -> None:
         """Block until the scheduler resolved this ticket; raise what it
@@ -109,6 +121,7 @@ class DocQueue:
         if (len(self._q) >= self.max_requests
                 or self._leaves + t.n_leaves > self.max_leaves):
             raise QueueFull(doc_id, len(self._q), retry_after_s)
+        t.depth_at_admission = len(self._q)
         self._q.append(t)
         self._leaves += t.n_leaves
 

@@ -45,12 +45,16 @@ import numpy as np
 
 from ..codec import packed as packed_mod
 from ..core.errors import CRDTError
+from ..obs.trace import CommitTrace
 from ..utils import profiling
 from .queue import SchedulerError, SchedulerStopped, WriteTicket
 
-# one work item: (doc, tickets, fused_batch_or_None, ticket_row_spans)
+# one work item: (doc, tickets, fused_batch_or_None, ticket_row_spans,
+# commit_trace) — the CommitTrace collects the per-stage breakdown and
+# member trace_ids for the commit's flight record (obs/trace.py)
 _WorkItem = Tuple["ServedDoc", List[WriteTicket],
-                  Optional[packed_mod.PackedOps], List[Tuple[int, int]]]
+                  Optional[packed_mod.PackedOps], List[Tuple[int, int]],
+                  CommitTrace]
 
 
 class MergeScheduler(threading.Thread):
@@ -115,11 +119,20 @@ class MergeScheduler(threading.Thread):
                     traceback.print_exc(file=sys.stderr)
                     err = SchedulerError(f"merge round failed: {e!r}")
                     err.__cause__ = e
-                    for _, tickets in drained:
-                        for t in tickets:
-                            if not t.done.is_set():
-                                t.error = err
-                                t.done.set()
+                    for doc, tickets in drained:
+                        pending = [t for t in tickets
+                                   if not t.done.is_set()]
+                        for t in pending:
+                            t.error = err
+                            t.done.set()
+                        if pending:
+                            # the round died before (or while) this
+                            # document's commit — leave an error record
+                            # behind for the post-mortem dump
+                            ct = CommitTrace(doc.doc_id, pending)
+                            ct.outcome = "error"
+                            ct.error = repr(e)
+                            self.engine.record_commit(doc, ct)
         self._fail_pending(SchedulerStopped("serving engine shut down"))
 
     def step(self) -> int:
@@ -161,6 +174,7 @@ class MergeScheduler(threading.Thread):
         work: List[_WorkItem] = []
         for doc, tickets in drained:
             doc.coalesce_width.observe(len(tickets))
+            ct = CommitTrace(doc.doc_id, tickets)
             spans: List[Tuple[int, int]] = []
             parts = []
             base = 0
@@ -169,24 +183,27 @@ class MergeScheduler(threading.Thread):
                 base += t.n_leaves
                 if t.n_leaves:
                     parts.append(t.packed)
-            with profiling.span("serve.fuse"):
+            with ct.stage("fuse"):
                 fused = packed_mod.concat_many(parts) if parts else None
+            ct.packed = fused
             if len(parts) > 1:
                 self.engine.counters.add("fused_batches")
                 self.engine.counters.add("fused_tickets", len(tickets))
-            work.append((doc, tickets, fused, spans))
+            work.append((doc, tickets, fused, spans, ct))
         return work
 
     def _process(self, work: List[_WorkItem]) -> None:
         singles: List[_WorkItem] = []
         groups: dict = {}
         for item in work:
-            doc, tickets, fused, spans = item
+            doc, tickets, fused, spans, ct = item
             if fused is None:      # only empty deltas this round
                 for t in tickets:
                     self.engine.finish_ticket(doc, t,
                                               np.zeros(0, dtype=bool))
                     t.done.set()
+                ct.outcome = "noop"
+                self.engine.record_commit(doc, ct)
                 continue
             # cross-doc grouping wants one launch per round: batches
             # that route to the kernel AND fit a single chunk — keyed by
@@ -214,8 +231,10 @@ class MergeScheduler(threading.Thread):
 
     def _guarded(self, fn, item: _WorkItem, *args) -> None:
         """Run one document's commit; a non-CRDT failure is recorded on
-        its tickets (handlers answer 500) — the scheduler survives."""
-        doc, tickets = item[0], item[1]
+        its tickets (handlers answer 500) — the scheduler survives.
+        Either way the commit's trace lands in the flight recorder
+        (an ``error`` outcome is one of its dump triggers)."""
+        doc, tickets, ct = item[0], item[1], item[4]
         t0 = time.perf_counter()
         try:
             fn(item, *args)
@@ -229,55 +248,95 @@ class MergeScheduler(threading.Thread):
                 if not t.done.is_set():
                     t.error = err
                     t.done.set()
+            ct.outcome = "error"
+            ct.error = repr(e)
+            # bill a grouped round's shared prepare+launch here too —
+            # an errored member of the slowest rounds must not
+            # under-report the dominant device step to the SLO tripwire
+            ct.total_ms = (time.perf_counter() - t0) * 1e3 \
+                + ct.stages_ms.get("batch_prepare", 0.0) \
+                + ct.stages_ms.get("batched_launch", 0.0)
+            self.engine.record_commit(doc, ct)
             return
-        doc.commit_ms.observe((time.perf_counter() - t0) * 1e3)
+        # a grouped commit's shared prepare + vmapped launch ran BEFORE
+        # _guarded (stamped into stages_ms by _process_grouped): bill
+        # them into the commit total too, or the SLO tripwire would be
+        # blind to the dominant device step of exactly these commits
+        total_ms = (time.perf_counter() - t0) * 1e3 \
+            + ct.stages_ms.get("batch_prepare", 0.0) \
+            + ct.stages_ms.get("batched_launch", 0.0)
+        doc.commit_ms.observe(total_ms)
+        ct.total_ms = total_ms
+        self.engine.record_commit(doc, ct)
 
     def _commit_single(self, item: _WorkItem) -> None:
-        doc, tickets, fused, spans = item
+        doc, tickets, fused, spans, ct = item
         n = fused.num_ops
         try:
-            with profiling.span("serve.merge"):
+            with ct.stage("merge"):
                 doc.tree.apply_packed_chunked(fused, self.engine.chunk_ops)
         except CRDTError:
-            self._sequential(doc, tickets)
+            self._sequential(doc, tickets, ct)
             return
-        doc.chunks_launched += max(1, -(-n // self.engine.chunk_ops))
+        chunks = max(1, -(-n // self.engine.chunk_ops))
+        doc.chunks_launched += chunks
+        ct.chunk_count = chunks
         self._attribute_and_publish(doc, tickets, spans,
-                                    doc.tree.last_applied_mask)
+                                    doc.tree.last_applied_mask, ct)
 
-    def _sequential(self, doc, tickets: List[WriteTicket]) -> None:
+    def _sequential(self, doc, tickets: List[WriteTicket],
+                    ct: CommitTrace) -> None:
         """Per-ticket fallback after a fused batch rejected: each delta
         applies (or 409s) on its own, exactly like the unfused service —
         only the guilty request fails."""
         self.engine.counters.add("sequential_fallbacks")
         any_applied = False
+        any_rejected = False
         for t in tickets:
             if t.n_leaves == 0:
                 self.engine.finish_ticket(doc, t, np.zeros(0, dtype=bool))
                 continue
             try:
-                with profiling.span("serve.merge"):
+                with ct.stage("merge"):
                     doc.tree.apply_packed_chunked(t.packed,
                                                   self.engine.chunk_ops)
             except CRDTError:
                 self.engine.reject_ticket(doc, t)
+                any_rejected = True
             else:
+                ct.chunk_count += max(
+                    1, -(-t.n_leaves // self.engine.chunk_ops))
                 mask = doc.tree.last_applied_mask
                 self.engine.finish_ticket(doc, t, mask)
+                ct.applied_ops += int(mask.sum())
                 any_applied = any_applied or bool(mask.any())
+        ct.dup_ops = sum(t.n_leaves for t in tickets
+                         if t.accepted) - ct.applied_ops
+        if not any_rejected:
+            ct.outcome = "committed"
+        elif any(t.accepted and t.n_leaves for t in tickets):
+            # empty deltas resolve accepted but carry nothing — they
+            # must not promote an all-rejected round to "partial"
+            ct.outcome = "partial"
+        else:
+            ct.outcome = "rejected"
         if any_applied:
-            with profiling.span("serve.publish"):
-                doc.publish()
+            with ct.stage("publish"):
+                ct.staleness_s = doc.publish()
         for t in tickets:
             t.done.set()
 
     def _attribute_and_publish(self, doc, tickets, spans,
-                               mask: np.ndarray) -> None:
+                               mask: np.ndarray,
+                               ct: CommitTrace) -> None:
         for t, (s, e) in zip(tickets, spans):
             self.engine.finish_ticket(doc, t, mask[s:e])
+        ct.applied_ops = int(mask.sum())
+        ct.dup_ops = ct.num_ops - ct.applied_ops
+        ct.outcome = "committed"
         if mask.any():
-            with profiling.span("serve.publish"):
-                doc.publish()
+            with ct.stage("publish"):
+                ct.staleness_s = doc.publish()
         for t in tickets:
             t.done.set()
 
@@ -306,10 +365,13 @@ class MergeScheduler(threading.Thread):
         on the tickets via :meth:`_guarded`."""
         import jax
         from ..parallel import mesh as mesh_mod
+        t0 = time.perf_counter()
         try:
-            prepared = [doc.tree.prepare_packed(fused)
-                        for doc, _, fused, _ in grouped]
-            stacked, ps = mesh_mod.stack_aligned(prepared)
+            with profiling.span("serve.batch_prepare"):
+                prepared = [doc.tree.prepare_packed(fused)
+                            for doc, _, fused, _, _ in grouped]
+                stacked, ps = mesh_mod.stack_aligned(prepared)
+            prep_ms = (time.perf_counter() - t0) * 1e3
             with profiling.span("serve.batched_launch"):
                 btab = mesh_mod.batched_materialize(
                     stacked, self._mesh_for(len(grouped)))
@@ -319,25 +381,38 @@ class MergeScheduler(threading.Thread):
             traceback.print_exc(file=sys.stderr)
             err = SchedulerError(f"batched launch failed: {e!r}")
             err.__cause__ = e
-            for _, tickets, _, _ in grouped:
+            for doc, tickets, _, _, ct in grouped:
                 for t in tickets:
                     t.error = err
                     t.done.set()
+                ct.outcome = "error"
+                ct.error = repr(e)
+                ct.total_ms = (time.perf_counter() - t0) * 1e3
+                self.engine.record_commit(doc, ct)
             return
+        launch_ms = (time.perf_counter() - t0) * 1e3 - prep_ms
         self.engine.counters.add("cross_doc_batches")
         self.engine.counters.add("cross_doc_docs", len(grouped))
         for i, item in enumerate(grouped):
+            # the group's shared wall time is billed to every member
+            # commit's breakdown (it gated all of them equally), split
+            # so "batched_launch" means the same thing in the record
+            # and the serve.batched_launch span: prepare_packed +
+            # stack_aligned land in their own stage
+            item[4].stages_ms["batch_prepare"] = round(prep_ms, 3)
+            item[4].stages_ms["batched_launch"] = round(launch_ms, 3)
             self._guarded(self._finish_grouped, item, ps[i],
                           jax.tree.map(lambda a, i=i: a[i], btab))
 
     def _finish_grouped(self, item: _WorkItem, p, table) -> None:
-        doc, tickets, fused, spans = item
+        doc, tickets, fused, spans, ct = item
         doc.chunks_launched += 1
+        ct.chunk_count = 1
         try:
-            with profiling.span("serve.merge"):
+            with ct.stage("merge"):
                 doc.tree.finish_packed(fused, p, table)
         except CRDTError:
-            self._sequential(doc, tickets)
+            self._sequential(doc, tickets, ct)
             return
         self._attribute_and_publish(doc, tickets, spans,
-                                    doc.tree.last_applied_mask)
+                                    doc.tree.last_applied_mask, ct)

@@ -35,7 +35,7 @@ class DocSnapshot:
 
     __slots__ = ("doc_id", "seq", "packed", "values", "clock", "replica",
                  "timestamp", "cursor", "max_depth", "log_length",
-                 "log_segments", "committed_at")
+                 "log_segments", "committed_at", "_fp")
 
     def __init__(self, doc_id: str, seq: int, packed: packed_mod.PackedOps,
                  values: Tuple[Any, ...], clock: Dict[int, int],
@@ -53,6 +53,7 @@ class DocSnapshot:
         self.log_length = log_length
         self.log_segments = log_segments
         self.committed_at = time.time()
+        self._fp: Optional[str] = None
 
     # -- read endpoints ---------------------------------------------------
 
@@ -65,6 +66,21 @@ class DocSnapshot:
 
     def age_s(self) -> float:
         return time.time() - self.committed_at
+
+    def fingerprint(self) -> str:
+        """Short content fingerprint of the published state (doc id,
+        seq, log length, server clock): the flight recorder stamps it
+        on every commit record so two records that claim the same
+        result can be compared across a dump without shipping the
+        columns.  Cached — derived once per snapshot."""
+        if self._fp is None:
+            import hashlib
+            h = hashlib.sha1()
+            h.update(repr((self.doc_id, self.seq, self.log_length,
+                           self.timestamp,
+                           sorted(self.clock.items()))).encode())
+            self._fp = h.hexdigest()[:16]
+        return self._fp
 
     def ops_since_bytes(self, since: int) -> bytes:
         """Wire JSON for ``GET /ops?since=`` straight off the snapshot's

@@ -24,6 +24,16 @@ companion text editor — interoperate unmodified):
 - ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
 - ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
 - ``GET  /metrics/scheduler``          → serving-engine counters + spans
+- ``GET  /metrics/prom``               → unified Prometheus-style text
+  exposition (doc counters, scheduler histograms WITH bucket bounds,
+  span registry, flight-recorder gauges — docs/OBSERVABILITY.md)
+- ``GET  /debug/flight``               → flight-recorder ring as JSON
+  (per-commit records: trace_ids, stage breakdown, fingerprints)
+
+Write tracing: ``POST /docs/{id}/ops`` mints a ``trace_id`` at
+admission (or adopts a well-formed ``X-Trace-Id`` request header),
+threads it through the coalescing scheduler into the commit's flight
+record, and echoes it in every response (body + ``X-Trace-Id``).
 
 Run: ``python -m crdt_graph_tpu.service [port]`` or embed via
 ``serve(port)`` / ``make_server(port)``.
@@ -57,6 +67,8 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
+from ..obs import prom as prom_mod
+from ..obs.trace import TRACE_HEADER, ensure_trace_id
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
                      SchedulerStopped, ServingEngine)
 from .store import DocumentStore
@@ -124,6 +136,18 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 elif sub == "/metrics/scheduler" and \
                         hasattr(store, "scheduler_metrics"):
                     self._send(200, store.scheduler_metrics())
+                elif sub == "/metrics/prom" and \
+                        hasattr(store, "render_prom"):
+                    # the unified Prometheus-style scrape: doc counters,
+                    # scheduler histograms with bucket bounds, the span
+                    # registry, flight gauges (obs/prom.py)
+                    self._send_raw(200, store.render_prom().encode(),
+                                   ctype=prom_mod.CONTENT_TYPE)
+                elif sub == "/debug/flight" and \
+                        hasattr(store, "debug_flight"):
+                    # the flight recorder's ring + counters, enriched
+                    # for post-mortem without waiting for a dump file
+                    self._send(200, store.debug_flight())
                 elif sub == "/docs":
                     self._send(200, {"docs": store.ids()})
                 else:
@@ -179,33 +203,48 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 self._send(200,
                            {"replica": store.get(doc_id).assign_replica()})
                 return
+            # trace admission point (obs/trace.py): mint — or adopt a
+            # well-formed client-supplied X-Trace-Id — BEFORE parsing,
+            # so even a malformed or shed request is attributable; the
+            # id rides the write ticket into the commit's flight record
+            # and is echoed in the response (body + header) so a client
+            # report joins against the server-side record
+            trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
+            trace_hdr = {TRACE_HEADER: trace_id}
             try:
-                accepted, applied = store.get(doc_id).apply_body(body)
+                accepted, applied = store.get(doc_id).apply_body(
+                    body, trace_id=trace_id)
             except QueueFull as e:
                 # admission control: the merge queue is at capacity —
                 # shed the write at the door with the server's own
                 # drain-time estimate (serve/queue.py)
                 self._send(429, {"error": str(e),
-                                 "retry_after_s": e.retry_after_s},
-                           headers={"Retry-After": str(e.retry_after_s)})
+                                 "retry_after_s": e.retry_after_s,
+                                 "trace_id": trace_id},
+                           headers={"Retry-After": str(e.retry_after_s),
+                                    **trace_hdr})
                 return
             except SchedulerStopped as e:
-                self._send(503, {"error": str(e)})
+                self._send(503, {"error": str(e), "trace_id": trace_id},
+                           headers=trace_hdr)
                 return
             except SchedulerError as e:
                 # server-side merge failure: MUST answer 500, never a
                 # client-error class — this request was well-formed and
                 # retrying it later is legitimate
-                self._send(500, {"error": str(e)})
+                self._send(500, {"error": str(e), "trace_id": trace_id},
+                           headers=trace_hdr)
                 return
             except (DecodeError, json.JSONDecodeError, ValueError) as e:
                 # ValueError: the native parser's rejections (same
                 # malformed-input class as DecodeError)
-                self._send(400, {"error": str(e)})
+                self._send(400, {"error": str(e), "trace_id": trace_id},
+                           headers=trace_hdr)
                 return
             from ..core import operation as op_mod
             n_applied = op_mod.count(applied)
-            payload = {"accepted": accepted, "applied_count": n_applied}
+            payload = {"accepted": accepted, "applied_count": n_applied,
+                       "trace_id": trace_id}
             # echo the applied ops only for interactive-scale deltas —
             # for a bootstrap-size push, re-encoding the whole batch
             # into the response costs multiples of the merge itself
@@ -213,7 +252,8 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # the ops it sent
             if n_applied <= ECHO_LIMIT:
                 payload["applied"] = json.loads(store.encode_ops(applied))
-            self._send(200 if accepted else 409, payload)
+            self._send(200 if accepted else 409, payload,
+                       headers=trace_hdr)
 
     return Handler
 

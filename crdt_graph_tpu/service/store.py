@@ -75,10 +75,15 @@ class Document:
     # monkeypatch the routing)
     WIRE_FAST_BYTES = WIRE_FAST_BYTES
 
-    def apply_body(self, body) -> Tuple[bool, Operation]:
+    def apply_body(self, body,
+                   trace_id: Optional[str] = None
+                   ) -> Tuple[bool, Operation]:
         """Merge a raw wire body (``bytes`` as read off the socket, or
         ``str``; the threshold is in BYTES, so handlers should pass the
-        undecoded body — ADVICE r4).  Small deltas decode to op objects
+        undecoded body — ADVICE r4).  ``trace_id`` is accepted for
+        write-path signature parity with ``ServedDoc.apply_body`` (the
+        handler always passes one); the legacy inline store has no
+        flight recorder, so it is ignored here.  Small deltas decode to op objects
         (sequence semantics, byte-for-byte the old path); bootstrap-size
         bodies stream through the native column ingest
         (engine.apply_wire) — the wire→objects→columns round trip

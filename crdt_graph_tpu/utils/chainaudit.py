@@ -336,6 +336,23 @@ def audit_summary(ops: Dict[str, np.ndarray], hints: str,
                              use_pallas=True).summary()
 
 
+def audit_packed_summary(p) -> dict:
+    """Shape-only audit of one serving batch (a ``PackedOps``) — the
+    flight recorder's sampled production tripwire (obs/flight.py):
+    every Nth commit re-derives the kernel trace for the batch that
+    just committed and bills it against the CI budget; an ``ok: false``
+    summary triggers a JSONL dump, so a trace regression shows up in
+    live serving, not just at the next bench round.  Mirrors the hint
+    mode the engine itself would elect (``engine._mode``: cond-free
+    exhaustive for vouched ingest, verified auto otherwise)."""
+    arrays = p.arrays()
+    no_deletes = not bool(np.any(np.asarray(arrays["kind"])[:p.num_ops]
+                                 == 1))
+    hints = "exhaustive" if p.hints_vouched else "auto"
+    return audit_materialize(arrays, hints, no_deletes,
+                             use_pallas=True).summary()
+
+
 def _main(argv) -> None:  # pragma: no cover - CLI convenience
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -2,15 +2,17 @@
 the TPU framework owes timing + tracing around its merge path).
 
 - :func:`timed` — wall-clock statistics for any jitted callable, closed by
-  a forced device→host readback of the result.  ``block_until_ready`` is
+  a forced device→host readback of the result; returns ``(stats,
+  result)`` so the float stats stay JSON-safe.  ``block_until_ready`` is
   NOT used: on this environment's experimental axon backend it returns
   before execution finishes (VERDICT round 2, Weak-1); only a readback is
   a trustworthy clock edge.  See bench.honest for the full harness
   (fingerprint returns, bracketing audit).
 - :func:`trace` — context manager around ``jax.profiler`` emitting a
   TensorBoard-loadable trace directory.  Works on CPU; on the axon TPU
-  backend ``stop_trace`` hangs (measured round 3) — prefer the
-  prefix-staged readback timing in scripts/probe_stages.py there.
+  backend ``stop_trace`` hangs (measured round 3) — honor the
+  ``GRAFT_NO_JAX_TRACE`` kill switch and the bounded stop timeout, or
+  prefer the prefix-staged readback timing in scripts/probe_stages.py.
 - :func:`table_stats` — structural summary of a merged NodeTable
   (fan-out, depth, tombstone load) for capacity planning and debugging.
 - :func:`span` / :func:`span_stats` — named wall-clock spans aggregated
@@ -22,9 +24,10 @@ the TPU framework owes timing + tracing around its merge path).
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Tuple
 
 import numpy as np
 
@@ -40,8 +43,15 @@ def _force(x):
 
 
 def timed(fn: Callable[..., Any], *args, repeats: int = 5,
-          warmup: int = 1) -> Dict[str, float]:
-    """Run ``fn(*args)`` with warmup, return ms timing stats.
+          warmup: int = 1) -> Tuple[Dict[str, float], Any]:
+    """Run ``fn(*args)`` with warmup, return ``(stats, result)``:
+    a pure-float ms stats dict and the last repeat's (forced) result.
+
+    The result used to ride INSIDE the stats dict under a ``"result"``
+    key, which made the "timing stats" a mixed bag of floats and device
+    values — callers that serialized or aggregated the stats dragged an
+    array along (ISSUE 5 satellite).  The two concerns are now separate
+    return values; ``stats`` is JSON-safe by construction.
 
     Each timed repeat ends with a full readback of the result; for large
     results prefer returning a scalar fingerprint from ``fn`` (see
@@ -58,13 +68,13 @@ def timed(fn: Callable[..., Any], *args, repeats: int = 5,
         out = _force(fn(*args))
         times.append(time.perf_counter() - t0)
     times.sort()
-    return {
+    stats = {
         "p50_ms": times[len(times) // 2] * 1e3,
         "min_ms": times[0] * 1e3,
         "max_ms": times[-1] * 1e3,
         "warmup_ms": first * 1e3,
-        "result": out,
     }
+    return stats, out
 
 
 _spans: Dict[str, Dict[str, float]] = {}
@@ -112,14 +122,82 @@ def reset_spans(prefix: str = "") -> None:
             del _spans[name]
 
 
+# latched True when a stop_trace join times out: the profiler session
+# is then still active in-process, so a later start_trace would raise
+# ("profile has already been started") — subsequent trace() calls
+# degrade to no-ops instead, exactly like the kill switch
+_trace_wedged = False
+
+
 @contextlib.contextmanager
-def trace(log_dir: str):
-    """``with trace("/tmp/tb"):`` captures a jax.profiler trace."""
+def trace(log_dir: str, stop_timeout_s: float = 60.0):
+    """``with trace("/tmp/tb"):`` captures a jax.profiler trace.
+
+    Two guards against the axon-backend hang (``stop_trace`` never
+    returns there — measured round 3):
+
+    - **Kill switch**: set ``GRAFT_NO_JAX_TRACE=1`` and the context is
+      a no-op (yields immediately, starts nothing) — the safe default
+      for scripted TPU sessions where a wedged stop would eat the whole
+      device-grant window.  Parsed by :func:`hostenv.flag_on` like
+      every other GRAFT kill-switch: ``"0"``, ``"off"`` and the empty
+      string mean tracing stays ON.
+    - **Stop timeout**: ``stop_trace`` runs in a helper thread joined
+      for ``stop_timeout_s`` seconds (env override
+      ``GRAFT_TRACE_STOP_TIMEOUT_S``).  On timeout the context returns
+      anyway with a stderr warning; the daemon helper thread is leaked
+      rather than the caller wedged — the trace directory may then be
+      incomplete, which is the lesser failure.  The wedge also latches
+      tracing OFF for the rest of the process: the profiler session is
+      still active, so another ``start_trace`` would raise mid-run —
+      later ``trace()`` calls are no-ops with a stderr note instead.
+    """
+    global _trace_wedged
+    from .hostenv import flag_on
+    if flag_on("GRAFT_NO_JAX_TRACE", default="0"):
+        yield
+        return
+    if _trace_wedged:
+        import sys
+        print("profiling.trace: skipped (an earlier stop_trace hung; "
+              "tracing is disabled for the rest of this process)",
+              file=sys.stderr)
+        yield
+        return
+    try:
+        stop_timeout_s = float(os.environ.get(
+            "GRAFT_TRACE_STOP_TIMEOUT_S", stop_timeout_s))
+    except ValueError:
+        pass
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        # run stop_trace in a joinable helper so a hang is bounded, but
+        # carry a fast failure back out — a stop that RAISED (I/O error
+        # writing the trace, profiler state clash) must not report
+        # success just because it didn't hang
+        stop_exc: list = []
+
+        def _stop():
+            try:
+                jax.profiler.stop_trace()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                stop_exc.append(e)
+
+        stopper = threading.Thread(target=_stop, daemon=True)
+        stopper.start()
+        stopper.join(stop_timeout_s)
+        if stopper.is_alive():
+            _trace_wedged = True
+            import sys
+            print(f"profiling.trace: stop_trace still hung after "
+                  f"{stop_timeout_s}s (axon backend?); abandoning the "
+                  f"stop thread — trace in {log_dir} may be incomplete "
+                  f"and tracing is now disabled for this process",
+                  file=sys.stderr)
+        elif stop_exc:
+            raise stop_exc[0]
 
 
 def table_stats(table) -> Dict[str, Any]:

@@ -1,13 +1,17 @@
 """Serving-engine smoke: concurrent pushes + reads across documents over
-real HTTP, then convergence and clean-shutdown checks.
+real HTTP, then convergence, telemetry-exposition, and clean-shutdown
+checks.
 
 The fast end-to-end gate for the scheduler (wired into tier-1 via
 tests/test_serve_smoke.py): W writers per document push causally valid
 deltas under distinct server-assigned replica ids while readers hammer
 every read endpoint; afterwards each document's ``/ops?since=0`` replay
 into a fresh engine must equal its served value sequence, the counters
-must account for every pushed op, and the server (plus its scheduler
-thread) must shut down cleanly.
+must account for every pushed op, the unified telemetry surface must
+hold (``/metrics/prom`` parses under the strict naming contract and
+``/debug/flight`` attributes every commit to the trace ids the pushes
+carried — ISSUE 5), and the server (plus its scheduler thread) must
+shut down cleanly.
 
 Run ad hoc: ``python scripts/serve_smoke.py [docs] [writers] [deltas]``
 """
@@ -15,6 +19,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -39,9 +44,9 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     port = srv.server_port
 
-    def req(method, path, body=None):
+    def req(method, path, body=None, headers=None):
         conn = HTTPConnection("127.0.0.1", port, timeout=60)
-        conn.request(method, path, body=body)
+        conn.request(method, path, body=body, headers=headers or {})
         resp = conn.getresponse()
         raw = resp.read()
         conn.close()
@@ -50,6 +55,8 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     doc_ids = [f"smoke{i}" for i in range(n_docs)]
     errors = []
     stop_readers = threading.Event()
+    pushed_trace_ids = set()
+    trace_lock = threading.Lock()
 
     def writer(doc_id):
         st, raw = req("POST", f"/docs/{doc_id}/replicas")
@@ -58,18 +65,26 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
             return
         rid = json.loads(raw)["replica"]
         prev, counter = 0, 0
-        for _ in range(deltas):
+        for di in range(deltas):
             ops = []
             for _ in range(delta_size):
                 counter += 1
                 ts = rid * 2**32 + counter
                 ops.append(Add(ts, (prev,), counter))
                 prev = ts
+            # admission tracing (ISSUE 5): a client-supplied trace id
+            # must come back in the response AND land on the commit's
+            # flight record (checked against /debug/flight below)
+            tid = f"smoke-{doc_id}-r{rid}-{di:02d}"
+            with trace_lock:
+                pushed_trace_ids.add(tid)
             st, raw = req("POST", f"/docs/{doc_id}/ops",
-                          json_codec.dumps(Batch(tuple(ops))))
+                          json_codec.dumps(Batch(tuple(ops))),
+                          headers={"X-Trace-Id": tid})
             out = json.loads(raw)
             if st != 200 or not out.get("accepted") \
-                    or out.get("applied_count") != delta_size:
+                    or out.get("applied_count") != delta_size \
+                    or out.get("trace_id") != tid:
                 errors.append(f"push {st}: {out}")
                 return
 
@@ -80,6 +95,11 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
                 if st != 200:
                     errors.append(f"read {sub} -> {st}")
                     return
+            # the scrape surface must hold up under live traffic too
+            st, _ = req("GET", "/metrics/prom")
+            if st != 200:
+                errors.append(f"read /metrics/prom -> {st}")
+                return
 
     writers = [threading.Thread(target=writer, args=(d,), daemon=True)
                for d in doc_ids for _ in range(writers_per_doc)]
@@ -87,6 +107,18 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
                for d in doc_ids]
     for t in writers:
         t.start()
+    # readers 404 until the writers' POST /replicas has materialized
+    # every document — wait for creation (a startup race, not a serving
+    # property; on a loaded box the first reader can outrun the first
+    # writer's request)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st, raw = req("GET", "/docs")
+        if st == 200 and set(doc_ids) <= set(json.loads(raw)["docs"]):
+            break
+        time.sleep(0.01)
+    else:
+        errors.append("documents never materialized")
     for t in readers:
         t.start()
     for t in writers:
@@ -118,6 +150,50 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     st, raw = req("GET", "/metrics/scheduler")
     assert st == 200
     summary["scheduler"] = json.loads(raw)
+
+    # unified telemetry exposition (ISSUE 5): /metrics/prom parses
+    # under the strict naming contract (crdt_ namespace, counters end
+    # _total, cumulative le buckets) and accounts for every document
+    from crdt_graph_tpu.obs import prom as prom_mod
+    st, raw = req("GET", "/metrics/prom")
+    assert st == 200, st
+    fams = prom_mod.parse_text(raw.decode())
+    for family in ("crdt_doc_ops_merged_total",
+                   "crdt_doc_commit_latency_ms", "crdt_span_ms_total",
+                   "crdt_flight_records_total"):
+        assert family in fams, f"missing prom family {family}"
+    merged_by_doc = {lbl["doc"]: v for _, lbl, v in
+                     fams["crdt_doc_ops_merged_total"]["samples"]}
+    for d in doc_ids:
+        assert merged_by_doc.get(d) == expected_ops, \
+            f"{d}: prom says {merged_by_doc.get(d)}"
+
+    # flight recorder: every commit record carries ≥1 trace id, and the
+    # records' union covers every id the pushes carried.  Records land
+    # ASYNCHRONOUSLY after the ticket resolves (the scheduler appends
+    # them after done.set()), so poll until coverage is complete before
+    # asserting — a one-shot scrape can race the final record.
+    deadline = time.time() + 30.0
+    while True:
+        st, raw = req("GET", "/debug/flight")
+        assert st == 200, st
+        flight = json.loads(raw)
+        seen_ids = set()
+        for r in flight["records"]:
+            seen_ids.update(r["trace_ids"])
+        if not (pushed_trace_ids - seen_ids) or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    assert flight["records"], "no flight records"
+    for r in flight["records"]:
+        assert r["trace_ids"], f"flight record {r['seq']} untraced"
+    missing = pushed_trace_ids - seen_ids
+    # the bounded ring may have evicted the oldest commits at scale;
+    # at smoke scale (records_total under capacity) nothing may be lost
+    if flight["records_total"] <= flight["capacity"]:
+        assert not missing, f"untracked pushes: {sorted(missing)[:5]}"
+    summary["flight"] = {"records_total": flight["records_total"],
+                         "trace_ids_seen": len(seen_ids)}
 
     # clean shutdown: server AND scheduler thread stop
     engine = srv.store

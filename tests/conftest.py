@@ -51,6 +51,21 @@ def pytest_configure(config):
         "for acceptance-scale workloads (e.g. the 1M-op serving soak)")
 
 
+@_pytest.fixture(autouse=True)
+def _reset_process_wide_observability():
+    """The span registry and the default flight recorder are
+    process-wide (by design — they are the production post-mortem
+    surface), which made span assertions depend on test ORDER: whichever
+    test touched a ServingEngine first left ``serve.*`` spans behind for
+    every later assertion.  Reset both after every test so each test
+    observes only its own telemetry (ISSUE 5 satellite)."""
+    yield
+    from crdt_graph_tpu.obs import flight as _flight
+    from crdt_graph_tpu.utils import profiling as _profiling
+    _profiling.reset_spans()
+    _flight.reset_default_recorder()
+
+
 @_pytest.fixture()
 def server():
     from crdt_graph_tpu.service import make_server

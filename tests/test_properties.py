@@ -148,8 +148,58 @@ def test_table_stats():
 def test_timed_harness():
     from crdt_graph_tpu.utils import timed
     p = packed.pack([crdt.Add(1, (0,), "a")])
-    stats = timed(lambda: merge.materialize(p.arrays()).ts, repeats=2)
-    assert stats["p50_ms"] > 0 and "result" in stats
+    stats, result = timed(lambda: merge.materialize(p.arrays()).ts,
+                          repeats=2)
+    # stats is pure floats (JSON-safe); the device result rides separately
+    assert stats["p50_ms"] > 0 and result is not None
+    assert all(isinstance(v, float) for v in stats.values())
+
+
+def test_trace_kill_switch_and_stop_timeout(monkeypatch, tmp_path):
+    """GRAFT_NO_JAX_TRACE parses like every other GRAFT kill-switch
+    (hostenv.flag_on: "0"/"off"/"" keep tracing ON) and a hung
+    stop_trace is bounded by GRAFT_TRACE_STOP_TIMEOUT_S."""
+    import threading
+    import time
+
+    import jax
+
+    from crdt_graph_tpu.utils import profiling
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    monkeypatch.setenv("GRAFT_NO_JAX_TRACE", "1")
+    with profiling.trace(str(tmp_path)):
+        pass
+    assert calls == []                     # no-op: profiler untouched
+    for off in ("0", "off", ""):
+        calls.clear()
+        monkeypatch.setenv("GRAFT_NO_JAX_TRACE", off)
+        with profiling.trace(str(tmp_path)):
+            pass
+        assert calls == [("start", str(tmp_path)), ("stop",)], off
+    # a wedged stop_trace (the axon hang) must not wedge the caller,
+    # and must latch tracing OFF for the rest of the process — the
+    # profiler session is still active, so another start_trace would
+    # raise mid-run
+    monkeypatch.setenv("GRAFT_NO_JAX_TRACE", "0")
+    monkeypatch.setenv("GRAFT_TRACE_STOP_TIMEOUT_S", "0.2")
+    monkeypatch.setattr(profiling, "_trace_wedged", False)
+    hang = threading.Event()
+    monkeypatch.setattr(jax.profiler, "stop_trace", hang.wait)
+    t0 = time.perf_counter()
+    with profiling.trace(str(tmp_path)):
+        pass
+    assert time.perf_counter() - t0 < 5.0
+    assert profiling._trace_wedged
+    calls.clear()
+    with profiling.trace(str(tmp_path)):   # no-op now, must not raise
+        pass
+    assert calls == []
+    hang.set()     # release the abandoned daemon stop thread
 
 
 def test_distributed_single_host_mesh():

@@ -23,3 +23,8 @@ def test_serve_smoke_end_to_end():
                                delta_size=8)
     assert len([k for k in summary if k.startswith("smoke")]) == 4
     assert summary["scheduler"]["queue_depth_total"] == 0
+    # the telemetry exposition is pinned in tier-1: the smoke scraped
+    # /metrics/prom (strict parse) and /debug/flight (trace-id
+    # coverage) before shutting down
+    assert summary["flight"]["records_total"] >= 1
+    assert summary["flight"]["trace_ids_seen"] >= 36   # 4×3×3 pushes
