@@ -1,0 +1,421 @@
+"""Closed-loop session load harness: drive the real HTTP surface with
+concurrent sessions and CHECK the session guarantees online (ISSUE 6).
+
+Unlike :mod:`~crdt_graph_tpu.bench.serving` (in-process, no checker),
+this harness is the serving layer's *verifier*: N closed-loop sessions
+talk to a real ``service.http`` server over sockets, every request
+stamped with session + trace ids, and the observed stream — write-ack
+trace echoes, read-path ``X-Commit-Seq``/``X-Snapshot-Fingerprint``
+headers, and the flight recorder's commit records (consumed via the
+in-process listener feed) — flows into a
+:class:`~crdt_graph_tpu.obs.oracle.SessionOracle` that checks
+read-your-writes, monotonic reads, dropped acks, and convergence as
+the load runs.  The run's headline (sustained merged ops/sec + reader
+p50/p99 under load + violation count) is the serving counterpart of
+the kernel bench headline (``scripts/bench_serve_headline.py`` commits
+it as ``BENCH_SERVE_r01_cpu.json``).
+
+Traffic shapes (mixed per run, assigned per session):
+
+- **editor replay** (bench config 1's flavor): append-mostly deltas
+  with occasional backspaces, a read after every acked write;
+- **write bursts** — back-to-back writes with no interleaved read, so
+  concurrent sessions' deltas pile into the scheduler's coalesced
+  commits (the first round is STAGED under a paused scheduler, so at
+  least one genuinely multi-writer commit is guaranteed, not
+  probabilistic);
+- **shed-and-read** — a small admission queue turns bursts into 429s;
+  a shed session issues reads while it backs off (reads must stay
+  monotone THROUGH shedding);
+- **giant-merge racer** — one session pushes a chunk-spanning delta
+  while everyone else's reads race the chunked merge.
+
+Usage: ``python -m crdt_graph_tpu.bench.loadgen [sessions] [writes]``
+(ad hoc; the committed entry points are the tier-1 smoke in
+tests/test_oracle.py and scripts/bench_serve_headline.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+import time
+from http.client import HTTPConnection
+from typing import Any, Dict, List, Optional
+
+from ..codec import json_codec
+from ..core.operation import Add, Batch, Delete
+from ..obs import oracle as oracle_mod
+from ..obs import prom as prom_mod
+from ..obs.trace import (COMMIT_SEQ_HEADER, SESSION_HEADER,
+                         SNAP_FP_HEADER, TRACE_HEADER)
+from ..serve import ServingEngine
+
+OFFSET = 2**32
+
+
+@dataclasses.dataclass
+class LoadgenConfig:
+    """One closed-loop run.  Defaults are smoke-sized; the headline
+    run (scripts/bench_serve_headline.py) scales sessions into the
+    hundreds and total leaves past 50k."""
+    n_sessions: int = 12
+    n_docs: int = 3
+    writes_per_session: int = 6
+    delta_size: int = 10
+    backspace_p: float = 0.15      # editor-replay flavor (config 1)
+    burst_fraction: float = 0.5    # sessions that burst (no read between)
+    max_queue_requests: int = 64   # small → 429 shedding is exercised
+    giant_ops: int = 0             # 0 = no giant-merge racer
+    stage_first_round: bool = True
+    read_timeout_s: float = 120.0
+    seed: int = 0
+
+
+class _Session(threading.Thread):
+    """One closed-loop session: its own HTTP connection, replica id,
+    causally valid op chain, and oracle reporting."""
+
+    def __init__(self, harness: "_Harness", idx: int):
+        super().__init__(name=f"loadgen-s{idx}", daemon=True)
+        self.h = harness
+        self.idx = idx
+        cfg = harness.cfg
+        self.sid = f"sess-{idx:04d}"
+        self.doc = f"load{idx % cfg.n_docs}"
+        self.burst = (idx % cfg.n_docs != 0 and
+                      random.Random(cfg.seed * 7919 + idx).random()
+                      < cfg.burst_fraction)
+        self.rng = random.Random(cfg.seed * 104729 + idx)
+        self.rid: Optional[int] = None
+        self.counter = 0
+        self.alive: List[int] = []     # own visible timestamps, in order
+        self.writes_acked = 0
+        self.leaves_acked = 0
+        self.shed_429 = 0
+        self.read_ms: List[float] = []
+        self.errors: List[str] = []
+        self._conn: Optional[HTTPConnection] = None
+
+    # -- transport --------------------------------------------------------
+
+    def _request(self, method: str, path: str, body=None, headers=None):
+        """Keep-alive request with one reconnect retry (the server may
+        have closed an idle connection)."""
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = HTTPConnection(
+                    "127.0.0.1", self.h.port,
+                    timeout=self.h.cfg.read_timeout_s)
+            try:
+                self._conn.request(method, path, body=body,
+                                   headers=headers or {})
+                resp = self._conn.getresponse()
+                raw = resp.read()
+                return resp, raw
+            except (OSError, ConnectionError):
+                self._conn.close()
+                self._conn = None
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")
+
+    # -- traffic ----------------------------------------------------------
+
+    def _delta(self, size: int) -> Batch:
+        """Editor-replay-shaped causally valid delta: appends at the
+        caret (own chain), occasional backspaces of own chars."""
+        ops = []
+        for _ in range(size):
+            if self.alive and self.rng.random() < self.h.cfg.backspace_p:
+                ops.append(Delete((self.alive.pop(),)))
+            else:
+                self.counter += 1
+                ts = self.rid * OFFSET + self.counter
+                anchor = self.alive[-1] if self.alive else 0
+                ops.append(Add(ts, (anchor,),
+                               chr(97 + self.counter % 26)))
+                self.alive.append(ts)
+        return Batch(tuple(ops))
+
+    def _read(self, final: bool = False) -> bool:
+        t0 = time.perf_counter()
+        resp, raw = self._request(
+            "GET", f"/docs/{self.doc}",
+            headers={SESSION_HEADER: self.sid})
+        ms = (time.perf_counter() - t0) * 1e3
+        if resp.status != 200:
+            self.errors.append(f"read -> {resp.status}")
+            return False
+        self.read_ms.append(ms)
+        seq = resp.getheader(COMMIT_SEQ_HEADER)
+        fp = resp.getheader(SNAP_FP_HEADER)
+        if seq is None:
+            self.errors.append("read missing X-Commit-Seq")
+            return False
+        if resp.getheader(SESSION_HEADER) != self.sid:
+            self.errors.append("session id not echoed")
+        ob = (self.h.oracle.observe_final_read if final
+              else self.h.oracle.observe_read)
+        ob(self.sid, self.doc, int(seq), fp)
+        return True
+
+    def _write(self, w: int, delta: Batch) -> bool:
+        """POST one delta; on 429, read while backing off and retry
+        (the shed-and-read shape).  Returns ack success."""
+        body = json_codec.dumps(delta)
+        tid = f"{self.sid}-w{w:04d}"
+        n_leaves = len(delta.ops)
+        deadline = time.monotonic() + self.h.cfg.read_timeout_s
+        while True:
+            resp, raw = self._request(
+                "POST", f"/docs/{self.doc}/ops", body=body,
+                headers={TRACE_HEADER: tid, SESSION_HEADER: self.sid})
+            if resp.status == 200:
+                out = json.loads(raw)
+                if not out.get("accepted") or \
+                        out.get("trace_id") != tid:
+                    self.errors.append(f"bad ack: {out}")
+                    return False
+                self.h.oracle.observe_write_ack(self.sid, self.doc, tid)
+                self.writes_acked += 1
+                self.leaves_acked += n_leaves
+                return True
+            if resp.status == 429:
+                # interleaved reads during shedding: session
+                # guarantees must hold THROUGH backpressure
+                self.shed_429 += 1
+                self._read()
+                retry = min(float(resp.getheader("Retry-After") or 1),
+                            0.05)
+                time.sleep(retry)
+                if time.monotonic() > deadline:
+                    self.errors.append("429 shed never drained")
+                    return False
+                continue
+            self.errors.append(
+                f"write -> {resp.status}: {raw[:120]!r}")
+            return False
+
+    def run(self) -> None:
+        try:
+            resp, raw = self._request("POST",
+                                      f"/docs/{self.doc}/replicas")
+            if resp.status != 200:
+                self.errors.append(f"replicas -> {resp.status}")
+                return
+            self.rid = json.loads(raw)["replica"]
+            cfg = self.h.cfg
+            for w in range(cfg.writes_per_session):
+                if not self._write(w, self._delta(cfg.delta_size)):
+                    return
+                # editor sessions read after every write (the
+                # read-your-writes probe); burst sessions only read at
+                # burst boundaries so their writes coalesce
+                if not self.burst or (w + 1) % 3 == 0:
+                    if not self._read():
+                        return
+            self._read()
+        except Exception as e:      # noqa: BLE001 — harness boundary
+            self.errors.append(repr(e))
+        finally:
+            if self._conn is not None:
+                self._conn.close()
+
+
+class _Harness:
+    def __init__(self, cfg: LoadgenConfig, engine: ServingEngine,
+                 port: int, oracle: oracle_mod.SessionOracle):
+        self.cfg = cfg
+        self.engine = engine
+        self.port = port
+        self.oracle = oracle
+
+
+def run(cfg: Optional[LoadgenConfig] = None,
+        engine: Optional[ServingEngine] = None,
+        oracle: Optional[oracle_mod.SessionOracle] = None
+        ) -> Dict[str, Any]:
+    """One closed-loop run against a fresh in-process HTTP server.
+    Returns the report dict (headline numbers + oracle verdict).  Pass
+    ``engine``/``oracle`` to control recorder capacity or fault
+    injection from tests."""
+    from ..service import make_server
+
+    cfg = cfg or LoadgenConfig()
+    own_engine = engine is None
+    engine = engine if engine is not None else ServingEngine(
+        max_queue_requests=cfg.max_queue_requests)
+    oracle = oracle if oracle is not None else oracle_mod.SessionOracle()
+    oracle.attach_engine(engine)
+    srv = make_server(port=0, store=engine)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        return _run(cfg, engine, oracle, srv)
+    finally:
+        # a mid-run exception must not leak the server, the scheduler
+        # thread, or — worst in a test process — the oracle's listener
+        # on a shared flight recorder (it would keep ingesting every
+        # later run's commits)
+        srv.shutdown()
+        srv.server_close()
+        oracle.detach_engine(engine)
+        if own_engine:
+            engine.close()
+
+
+def _run(cfg: LoadgenConfig, engine: ServingEngine,
+         oracle: oracle_mod.SessionOracle, srv) -> Dict[str, Any]:
+    harness = _Harness(cfg, engine, srv.server_port, oracle)
+    sessions = [_Session(harness, i) for i in range(cfg.n_sessions)]
+
+    staged = False
+    if cfg.stage_first_round and cfg.n_sessions >= 2:
+        # guarantee ≥1 genuinely coalesced multi-writer commit: hold
+        # the scheduler while the first wave of writes queues up, then
+        # release it as one fused round per document
+        engine.scheduler.pause()
+    t_start = time.perf_counter()
+    try:
+        for s in sessions:
+            s.start()
+        if cfg.stage_first_round and cfg.n_sessions >= 2:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if any(len(d.queue) >= 2 for d in engine.docs()):
+                    staged = True
+                    break
+                time.sleep(0.005)
+    finally:
+        if cfg.stage_first_round and cfg.n_sessions >= 2:
+            engine.scheduler.resume()
+
+    giant_err: List[str] = []
+    giant_s = None
+    if cfg.giant_ops:
+        # the giant-merge racer: one chunk-spanning push lands on doc 0
+        # mid-run while every session on that document keeps reading.
+        # Under a small admission queue the giant gets shed like anyone
+        # else — it backs off through the 429s until admitted.
+        def giant():
+            nonlocal giant_s
+            conn = HTTPConnection("127.0.0.1", harness.port, timeout=600)
+            try:
+                conn.request("POST", "/docs/load0/replicas")
+                rid = json.loads(conn.getresponse().read())["replica"]
+                ops, prev = [], 0
+                for i in range(cfg.giant_ops):
+                    ts = rid * OFFSET + i + 1
+                    ops.append(Add(ts, (prev,), i % 997))
+                    prev = ts
+                body = json_codec.dumps(Batch(tuple(ops)))
+                deadline = time.monotonic() + cfg.read_timeout_s
+                t0 = time.perf_counter()
+                while True:
+                    conn.request(
+                        "POST", "/docs/load0/ops", body=body,
+                        headers={TRACE_HEADER: "giant-racer-push",
+                                 SESSION_HEADER: "sess-giant"})
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    if resp.status == 429:
+                        if time.monotonic() > deadline:
+                            giant_err.append("giant 429 never drained")
+                            return
+                        time.sleep(min(float(
+                            resp.getheader("Retry-After") or 1), 0.1))
+                        continue
+                    break
+                out = json.loads(raw)
+                if resp.status != 200 or not out.get("accepted"):
+                    giant_err.append(f"giant -> {resp.status}")
+                else:
+                    giant_s = time.perf_counter() - t0
+                    oracle.observe_write_ack("sess-giant", "load0",
+                                             "giant-racer-push")
+            except Exception as e:  # noqa: BLE001 — harness boundary
+                giant_err.append(repr(e))
+            finally:
+                conn.close()
+        giant_thread = threading.Thread(target=giant, daemon=True)
+        giant_thread.start()
+    for s in sessions:
+        s.join(600)
+    if cfg.giant_ops:
+        giant_thread.join(600)
+    load_wall_s = time.perf_counter() - t_start
+
+    # quiescence: drain everything admitted above and flush the flight
+    # stream (the barrier — no records_total polling), then the final
+    # convergence read round
+    flushed = engine.flush(timeout=120)
+    conn = HTTPConnection("127.0.0.1", harness.port, timeout=60)
+    try:
+        for s in sessions:
+            conn.request("GET", f"/docs/{s.doc}",
+                         headers={SESSION_HEADER: s.sid})
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status == 200:
+                oracle.observe_final_read(
+                    s.sid, s.doc,
+                    int(resp.getheader(COMMIT_SEQ_HEADER)),
+                    resp.getheader(SNAP_FP_HEADER))
+        # the scrape surface must hold (strictly) with the oracle
+        # families present at the end of a loaded run
+        conn.request("GET", "/metrics/prom")
+        prom_text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    fams = prom_mod.parse_text(prom_text)
+    violations = oracle.finalize()
+
+    read_ms = sorted(m for s in sessions for m in s.read_ms)
+    errors = [e for s in sessions for e in s.errors] + giant_err
+    merged = sum(d.ops_merged for d in engine.docs())
+    n = len(read_ms)
+    ost = oracle.stats()
+    out = {
+        "harness": "loadgen",
+        "sessions": cfg.n_sessions,
+        "docs": cfg.n_docs,
+        "staged_first_round": staged,
+        "writes_acked": sum(s.writes_acked for s in sessions)
+        + (1 if cfg.giant_ops and not giant_err else 0),
+        "leaves_acked": sum(s.leaves_acked for s in sessions)
+        + (cfg.giant_ops if cfg.giant_ops and not giant_err else 0),
+        "ops_merged": merged,
+        "load_wall_s": round(load_wall_s, 3),
+        "ops_per_sec": round(merged / load_wall_s, 1),
+        "reads": n,
+        "read_p50_ms": round(read_ms[n // 2], 3) if n else None,
+        "read_p99_ms": round(read_ms[(99 * n) // 100], 3) if n else None,
+        "read_max_ms": round(read_ms[-1], 3) if n else None,
+        "shed_429": sum(s.shed_429 for s in sessions),
+        "giant_ops": cfg.giant_ops,
+        "giant_commit_s": round(giant_s, 3) if giant_s else None,
+        "flushed": flushed,
+        "oracle": ost,
+        "violations": violations,
+        "prom_families": len(fams),
+        "prom_oracle_families": sorted(
+            f for f in fams if f.startswith("crdt_oracle_")),
+        "errors": errors[:8],
+        "flight": engine.flight.stats(),
+    }
+    return out
+
+
+def main(argv) -> None:
+    cfg = LoadgenConfig()
+    if argv:
+        cfg.n_sessions = int(argv[0])
+    if len(argv) > 1:
+        cfg.writes_per_session = int(argv[1])
+    print(json.dumps(run(cfg)), flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1:])

@@ -18,19 +18,31 @@ post-mortem.  This package ties them together per commit:
   (``GET /metrics/prom``) merging store counters, scheduler histograms
   (bucket bounds, not just quantiles), the span registry, and flight
   gauges; plus the enriched ``GET /debug/flight`` JSON.
+- :mod:`~crdt_graph_tpu.obs.oracle` — the online session-guarantee
+  oracle (ISSUE 6): read-your-writes / monotonic-read / convergence
+  checks over the trace+flight stream, with seeded fault injection
+  (``GRAFT_ORACLE_FAULT``) proving the detection path.
 
 See docs/OBSERVABILITY.md for the lifecycle, the record schema, and
 the dump-trigger contract.
 """
 from .flight import CommitRecord, FlightRecorder, get_default_recorder
-from .trace import (TRACE_HEADER, CommitTrace, ensure_trace_id,
-                    mint_trace_id)
+from .oracle import FaultInjector, SessionOracle
+from .trace import (COMMIT_SEQ_HEADER, SESSION_HEADER, SNAP_FP_HEADER,
+                    TRACE_HEADER, CommitTrace, ensure_session_id,
+                    ensure_trace_id, mint_trace_id)
 
 __all__ = [
+    "COMMIT_SEQ_HEADER",
+    "SESSION_HEADER",
+    "SNAP_FP_HEADER",
     "TRACE_HEADER",
     "CommitRecord",
     "CommitTrace",
+    "FaultInjector",
     "FlightRecorder",
+    "SessionOracle",
+    "ensure_session_id",
     "ensure_trace_id",
     "get_default_recorder",
     "mint_trace_id",

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 REASON_SLO = "slo"
 REASON_AUDIT = "audit"
 REASON_ERROR = "error"
+REASON_ORACLE = "oracle"     # a session-guarantee violation (obs/oracle.py)
 REASON_MANUAL = "manual"
 
 
@@ -130,6 +131,25 @@ class FlightRecorder:
         self._audit_failures = 0
         self._errors = 0
         self._last_commit_ms = 0.0
+        self._listeners: List[Any] = []
+        self._listener_errors = 0
+
+    # -- listeners --------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(record_dict)`` to every future record — the
+        in-process push feed (the session-guarantee oracle consumes
+        commit records this way instead of polling ``/debug/flight``).
+        Called on the recording thread (the scheduler): listeners must
+        be fast and must not block."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     # -- sampling ---------------------------------------------------------
 
@@ -168,6 +188,18 @@ class FlightRecorder:
             if self.slo_ms > 0 and rec.total_ms > self.slo_ms:
                 self._slo_breaches += 1
                 reason = reason or REASON_SLO
+            listeners = list(self._listeners)
+        # the push feed runs OUTSIDE the recorder lock (a listener may
+        # take its own locks — the oracle does) but still on the
+        # recording thread; a failing listener is counted, never raised
+        if listeners:
+            payload = rec.to_json()
+            for fn in listeners:
+                try:
+                    fn(payload)
+                except Exception:    # noqa: BLE001 — listener boundary
+                    with self._lock:
+                        self._listener_errors += 1
         if reason is None:
             return None
         try:
@@ -237,6 +269,7 @@ class FlightRecorder:
                 "dumps": dict(self._dumps),
                 "last_dump_path": self._last_dump_path,
                 "last_commit_ms": round(self._last_commit_ms, 3),
+                "listener_errors": self._listener_errors,
             }
 
     def debug_view(self) -> Dict[str, Any]:
@@ -260,6 +293,8 @@ class FlightRecorder:
             self._audit_failures = 0
             self._errors = 0
             self._last_commit_ms = 0.0
+            self._listeners = []
+            self._listener_errors = 0
 
 
 # -- process-wide default -------------------------------------------------

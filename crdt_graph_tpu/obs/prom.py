@@ -221,6 +221,27 @@ def render_engine(engine) -> str:
             fs["slo_ms"])
     w.gauge("crdt_flight_last_commit_ms",
             "Latency of the most recent commit", fs["last_commit_ms"])
+
+    # -- session-guarantee oracle (when one is attached) ------------------
+    oracle = getattr(engine, "oracle", None)
+    if oracle is not None:
+        ost = oracle.stats()
+        w.counter("crdt_oracle_sessions_total",
+                  "Distinct sessions the oracle has observed",
+                  ost["sessions"])
+        w.counter("crdt_oracle_commits_ingested_total",
+                  "Flight commit records the oracle consumed",
+                  ost["commits_ingested"])
+        for check in sorted(ost["checks"]):
+            w.counter("crdt_oracle_checks_total",
+                      "Session-guarantee checks evaluated, by check",
+                      ost["checks"][check], {"check": check})
+            w.counter("crdt_oracle_violations_total",
+                      "Session-guarantee violations detected, by check",
+                      ost["violations"].get(check, 0), {"check": check})
+        w.gauge("crdt_oracle_pending_writes",
+                "Acked writes awaiting commit-record resolution",
+                ost["pending_writes"])
     return w.render()
 
 

@@ -33,6 +33,13 @@ from typing import Dict, Optional, Tuple
 # ingest; http.client normalizes)
 TRACE_HEADER = "X-Trace-Id"
 
+# read-path correlation headers (ISSUE 6): every served read echoes the
+# snapshot it resolved against, so a session checker can join reads to
+# the commit stream without trusting the body
+SESSION_HEADER = "X-Session-Id"
+SNAP_FP_HEADER = "X-Snapshot-Fingerprint"
+COMMIT_SEQ_HEADER = "X-Commit-Seq"
+
 # accepted client-supplied ids: 8-64 url-safe chars (anything else is
 # re-minted — the id lands in filenames and label values)
 _TRACE_RE = re.compile(r"^[A-Za-z0-9_.-]{8,64}$")
@@ -44,11 +51,24 @@ def mint_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def is_valid_id(candidate: Optional[str]) -> bool:
+    """Whether a client-supplied trace/session id may be adopted
+    (8-64 url-safe chars — it lands in filenames and label values)."""
+    return bool(candidate and _TRACE_RE.match(candidate))
+
+
 def ensure_trace_id(candidate: Optional[str]) -> str:
     """Adopt a well-formed client id, mint otherwise."""
-    if candidate and _TRACE_RE.match(candidate):
+    if is_valid_id(candidate):
         return candidate
     return mint_trace_id()
+
+
+def ensure_session_id(candidate: Optional[str]) -> str:
+    """Adopt a well-formed client ``X-Session-Id``, mint otherwise
+    (same alphabet contract as trace ids — session ids land in oracle
+    violation details and label values)."""
+    return ensure_trace_id(candidate)
 
 
 class CommitTrace:

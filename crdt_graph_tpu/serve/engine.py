@@ -39,6 +39,7 @@ from ..codec import packed as packed_mod
 from ..core import operation as op_mod
 from ..core.operation import Batch, Operation
 from ..obs import flight as flight_mod
+from ..obs import oracle as oracle_mod
 from ..obs import trace as trace_mod
 from ..oplog import PackedBatch
 from . import snapshot as snapshot_mod
@@ -90,6 +91,7 @@ class ServedDoc:
         self.chunks_launched = 0
         self._seq = 0
         self._snap = snapshot_mod.derive(doc_id, 0, self.tree)
+        self._prev_snap: Optional[snapshot_mod.DocSnapshot] = None
 
     # -- snapshot publication (scheduler thread only) ---------------------
 
@@ -98,14 +100,34 @@ class ServedDoc:
         tree.  Single writer (the scheduler), so ``seq`` is strictly
         monotone; the attribute store is the linearization point.
         Returns the OUTGOING snapshot's age — the read staleness this
-        publish just retired, stamped on the commit's flight record."""
+        publish just retired, stamped on the commit's flight record.
+        Under fault injection only, the outgoing snapshot is retained
+        one generation as the stale/regress target (obs/oracle.py)."""
         staleness = self._snap.age_s()
+        if self._engine.fault is not None:
+            # only fault injection ever serves the previous generation
+            # (read_view); in production retaining it would double the
+            # per-document snapshot footprint for nothing
+            self._prev_snap = self._snap
         self._seq += 1
         self._snap = snapshot_mod.derive(self.doc_id, self._seq, self.tree)
         return staleness
 
     def snapshot_view(self) -> snapshot_mod.DocSnapshot:
         """The current published snapshot (lock-free)."""
+        return self._snap
+
+    def read_view(self) -> snapshot_mod.DocSnapshot:
+        """The snapshot a READ endpoint should serve: normally the
+        published snapshot, but under armed ``stale``/``regress``
+        fault injection (``GRAFT_ORACLE_FAULT``, obs/oracle.py) ONE
+        read is deliberately served the previous generation so the
+        session-guarantee oracle's detection path is proven against a
+        real violation, not a simulated one."""
+        fault = self._engine.fault
+        if fault is not None and self._prev_snap is not None and (
+                fault.pop("stale") or fault.pop("regress")):
+            return self._prev_snap
         return self._snap
 
     # -- Document-compatible read API (all lock-free) ---------------------
@@ -184,6 +206,7 @@ class ServingEngine:
                  wire_fast_bytes: int = WIRE_FAST_BYTES,
                  submit_timeout_s: float = 600.0,
                  flight: Optional[flight_mod.FlightRecorder] = None,
+                 fault: Optional[oracle_mod.FaultInjector] = None,
                  start: bool = True):
         from .scheduler import MergeScheduler
         self._docs: Dict[str, ServedDoc] = {}
@@ -202,6 +225,13 @@ class ServingEngine:
         # engine error (obs/flight.py; docs/OBSERVABILITY.md)
         self.flight = flight if flight is not None \
             else flight_mod.get_default_recorder()
+        # fault injection for the session-guarantee oracle's CI proof
+        # (GRAFT_ORACLE_FAULT; obs/oracle.py) — None in production
+        self.fault = fault if fault is not None \
+            else oracle_mod.FaultInjector.from_env()
+        # a SessionOracle attached via oracle.attach_engine() — renders
+        # the crdt_oracle_* prom families when present
+        self.oracle: Optional[oracle_mod.SessionOracle] = None
         self.scheduler = MergeScheduler(self)
         if start:
             self.scheduler.start()
@@ -326,6 +356,12 @@ class ServingEngine:
         every Nth commit, and let the recorder fire its dump triggers.
         Never raises — observability must not take down the scheduler
         (a failed audit sample is recorded, not propagated)."""
+        if ct.outcome == "dropped":
+            # injected dropped-ack fault (obs/oracle.py): the tickets
+            # were acked but the commit intentionally left NO publish
+            # and NO flight record — the oracle must find the hole
+            self.counters.add("fault_dropped_commits")
+            return
         audit = None
         if (ct.packed is not None and ct.outcome in
                 ("committed", "partial")
@@ -392,6 +428,17 @@ class ServingEngine:
         """The enriched flight-recorder view (``GET /debug/flight``):
         recorder config + counters + the full commit-record ring."""
         return self.flight.debug_view()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Barrier: block until every ticket admitted BEFORE this call
+        has resolved and its flight record has landed, WITHOUT closing
+        the engine (the ``close()``-as-barrier / ``records_total``
+        polling replacement — records land asynchronously after the
+        ticket resolves, docs/OBSERVABILITY.md).  Returns False on
+        timeout (e.g. a paused scheduler with pending work) and on a
+        stopping or stopped scheduler (close() fails tickets without
+        flight records, so the barrier cannot hold)."""
+        return self.scheduler.flush(timeout=timeout)
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop the scheduler and fail any unresolved tickets (503) —

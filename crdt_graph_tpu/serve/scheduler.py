@@ -68,6 +68,11 @@ class MergeScheduler(threading.Thread):
         self._stop_requested = False
         self._paused = 0
         self._meshes = {}
+        # True while a drained round is being processed off-lock: the
+        # flush() barrier must not report quiescence between drain and
+        # the round's last flight record
+        self._busy = False
+        self._rounds_completed = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -107,6 +112,7 @@ class MergeScheduler(threading.Thread):
                 if self._stop_requested:
                     break
                 drained = self._drain_locked()
+                self._busy = bool(drained)
             if drained:
                 # a failure ANYWHERE in the round (fusion allocation,
                 # grouping logic) must resolve the already-drained
@@ -133,6 +139,14 @@ class MergeScheduler(threading.Thread):
                             ct.outcome = "error"
                             ct.error = repr(e)
                             self.engine.record_commit(doc, ct)
+                finally:
+                    with self.cond:
+                        self._busy = False
+                        self._rounds_completed += 1
+                        self.cond.notify_all()
+        with self.cond:
+            self._busy = False
+            self.cond.notify_all()
         self._fail_pending(SchedulerStopped("serving engine shut down"))
 
     def step(self) -> int:
@@ -142,12 +156,46 @@ class MergeScheduler(threading.Thread):
         invariant on the trees)."""
         with self.cond:
             drained = self._drain_locked()
-        if drained:
-            self._process(self._fuse_all(drained))
+            self._busy = bool(drained)
+        try:
+            if drained:
+                self._process(self._fuse_all(drained))
+        finally:
+            # the flush() barrier must see a step()-driven round too
+            with self.cond:
+                self._busy = False
+                self.cond.notify_all()
         return len(drained)
 
     def _has_work(self) -> bool:
         return any(len(d.queue) for d in self.engine.docs())
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Join the scheduler up to the current queue state WITHOUT
+        stopping it: block until no queue holds a ticket admitted
+        before this call AND no drained round is still processing.
+        When this returns True every such ticket has resolved and its
+        flight record has been recorded (records are written inside
+        the round, before ``_busy`` clears) — the barrier the tests
+        and the session-guarantee oracle use instead of polling
+        ``/debug/flight`` ``records_total`` or calling ``close()``.
+        Returns False on timeout (e.g. the scheduler is paused or
+        wedged with work still pending)."""
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while True:
+                if self._stop_requested:
+                    # a stopping (or stopped) scheduler fails pending
+                    # tickets WITHOUT flight records — the barrier's
+                    # guarantee cannot hold, so never report it does
+                    # (even after _fail_pending has drained the queues)
+                    return False
+                if not (self._busy or self._has_work()):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cond.wait(min(remaining, self.poll_s))
 
     def _fail_pending(self, err: BaseException) -> None:
         with self.cond:
@@ -334,6 +382,19 @@ class MergeScheduler(threading.Thread):
         ct.applied_ops = int(mask.sum())
         ct.dup_ops = ct.num_ops - ct.applied_ops
         ct.outcome = "committed"
+        fault = self.engine.fault
+        if fault is not None and fault.pop("drop"):
+            # injected dropped-ack (GRAFT_ORACLE_FAULT=drop,
+            # obs/oracle.py): ack the tickets WITHOUT publishing the
+            # snapshot and WITHOUT a flight record — the merged ops sit
+            # silently in the tree until some later commit publishes
+            # them, exactly the failure shape the oracle's
+            # quiescence check must catch (an acked trace id that never
+            # appears in the commit stream)
+            ct.outcome = "dropped"
+            for t in tickets:
+                t.done.set()
+            return
         if mask.any():
             with ct.stage("publish"):
                 ct.staleness_s = doc.publish()

@@ -35,6 +35,14 @@ admission (or adopts a well-formed ``X-Trace-Id`` request header),
 threads it through the coalescing scheduler into the commit's flight
 record, and echoes it in every response (body + ``X-Trace-Id``).
 
+Read tracing (ISSUE 6): ``GET /docs/{id}`` and ``GET /docs/{id}/
+snapshot`` resolve body AND headers against ONE snapshot view and echo
+``X-Snapshot-Fingerprint`` + ``X-Commit-Seq`` (the served snapshot's
+identity) plus an adopted-or-minted ``X-Session-Id`` — so reads are as
+attributable as writes and a session-guarantee checker
+(obs/oracle.py) can join every read to the commit stream.  Writes
+echo a well-formed client ``X-Session-Id`` too.
+
 Run: ``python -m crdt_graph_tpu.service [port]`` or embed via
 ``serve(port)`` / ``make_server(port)``.
 
@@ -68,7 +76,9 @@ from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
 from ..obs import prom as prom_mod
-from ..obs.trace import TRACE_HEADER, ensure_trace_id
+from ..obs.trace import (COMMIT_SEQ_HEADER, SESSION_HEADER,
+                         SNAP_FP_HEADER, TRACE_HEADER, ensure_session_id,
+                         ensure_trace_id, is_valid_id)
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
                      SchedulerStopped, ServingEngine)
 from .store import DocumentStore
@@ -127,6 +137,17 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
         def _body(self, n: int) -> bytes:
             return self.rfile.read(n)
 
+        def _read_trace_headers(self, snap) -> dict:
+            """Read-path correlation headers (obs/trace.py): the served
+            snapshot's identity plus the session id (adopted from a
+            well-formed ``X-Session-Id``, minted otherwise)."""
+            return {
+                SNAP_FP_HEADER: snap.fingerprint(),
+                COMMIT_SEQ_HEADER: str(snap.seq),
+                SESSION_HEADER: ensure_session_id(
+                    self.headers.get(SESSION_HEADER)),
+            }
+
         def do_GET(self):
             doc_id, sub, query = self._route()
             if doc_id is None:
@@ -158,7 +179,15 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 self._send(404, {"error": f"no document {doc_id}"})
                 return
             if sub == "":
-                self._send(200, {"values": doc.snapshot()})
+                if hasattr(doc, "read_view"):
+                    # body and headers come from the SAME snapshot: a
+                    # checker correlating the fingerprint header to the
+                    # values body must never straddle a publish
+                    snap = doc.read_view()
+                    self._send(200, {"values": snap.visible_values()},
+                               headers=self._read_trace_headers(snap))
+                else:       # legacy DocumentStore: no snapshot identity
+                    self._send(200, {"values": doc.snapshot()})
             elif sub == "/ops":
                 try:
                     since = int(query.get("since", ["0"])[0])
@@ -169,8 +198,14 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 # the full log, so avoid a json.loads/dumps round trip
                 self._send_raw(200, doc.dumps_since_bytes(since))
             elif sub == "/snapshot":
-                self._send_raw(200, doc.snapshot_packed(),
-                               ctype="application/octet-stream")
+                if hasattr(doc, "read_view"):
+                    snap = doc.read_view()
+                    self._send_raw(200, snap.checkpoint_bytes(),
+                                   ctype="application/octet-stream",
+                                   headers=self._read_trace_headers(snap))
+                else:
+                    self._send_raw(200, doc.snapshot_packed(),
+                                   ctype="application/octet-stream")
             elif sub == "/clock":
                 self._send(200, {"replicas": doc.clock()})
             elif sub == "/metrics":
@@ -211,6 +246,11 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # report joins against the server-side record
             trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
             trace_hdr = {TRACE_HEADER: trace_id}
+            # echo a client-supplied session id on writes too, so one
+            # session's whole request stream correlates on both paths
+            sess = self.headers.get(SESSION_HEADER)
+            if is_valid_id(sess):
+                trace_hdr[SESSION_HEADER] = sess
             try:
                 accepted, applied = store.get(doc_id).apply_body(
                     body, trace_id=trace_id)

@@ -5,13 +5,17 @@ checks.
 The fast end-to-end gate for the scheduler (wired into tier-1 via
 tests/test_serve_smoke.py): W writers per document push causally valid
 deltas under distinct server-assigned replica ids while readers hammer
-every read endpoint; afterwards each document's ``/ops?since=0`` replay
-into a fresh engine must equal its served value sequence, the counters
-must account for every pushed op, the unified telemetry surface must
-hold (``/metrics/prom`` parses under the strict naming contract and
-``/debug/flight`` attributes every commit to the trace ids the pushes
-carried — ISSUE 5), and the server (plus its scheduler thread) must
-shut down cleanly.
+every read endpoint; each writer then verifies READ-YOUR-WRITES over
+the wire (its acked values must all appear in a follow-up read, whose
+``X-Commit-Seq``/``X-Snapshot-Fingerprint``/``X-Session-Id`` headers
+identify the serving snapshot — ISSUE 6); afterwards each document's
+``/ops?since=0`` replay into a fresh engine must equal its served
+value sequence, the counters must account for every pushed op, the
+unified telemetry surface must hold (``/metrics/prom`` parses under
+the strict naming contract and ``/debug/flight`` attributes every
+commit to the trace ids the pushes carried — ISSUE 5, one scrape
+after the ``ServingEngine.flush`` barrier), and the server (plus its
+scheduler thread) must shut down cleanly.
 
 Run ad hoc: ``python scripts/serve_smoke.py [docs] [writers] [deltas]``
 """
@@ -44,13 +48,17 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     port = srv.server_port
 
-    def req(method, path, body=None, headers=None):
+    def req_full(method, path, body=None, headers=None):
         conn = HTTPConnection("127.0.0.1", port, timeout=60)
         conn.request(method, path, body=body, headers=headers or {})
         resp = conn.getresponse()
         raw = resp.read()
         conn.close()
-        return resp.status, raw
+        return resp.status, raw, resp
+
+    def req(method, path, body=None, headers=None):
+        st, raw, _ = req_full(method, path, body=body, headers=headers)
+        return st, raw
 
     doc_ids = [f"smoke{i}" for i in range(n_docs)]
     errors = []
@@ -64,13 +72,19 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
             errors.append(f"replicas {st}")
             return
         rid = json.loads(raw)["replica"]
+        sess = f"smoke-sess-{doc_id}-r{rid}"
         prev, counter = 0, 0
+        own_values = []
         for di in range(deltas):
             ops = []
             for _ in range(delta_size):
                 counter += 1
                 ts = rid * 2**32 + counter
-                ops.append(Add(ts, (prev,), counter))
+                # per-writer-unique values so the read-your-writes
+                # check below is not vacuous
+                val = f"{rid}:{counter}"
+                own_values.append(val)
+                ops.append(Add(ts, (prev,), val))
                 prev = ts
             # admission tracing (ISSUE 5): a client-supplied trace id
             # must come back in the response AND land on the commit's
@@ -80,13 +94,35 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
                 pushed_trace_ids.add(tid)
             st, raw = req("POST", f"/docs/{doc_id}/ops",
                           json_codec.dumps(Batch(tuple(ops))),
-                          headers={"X-Trace-Id": tid})
+                          headers={"X-Trace-Id": tid,
+                                   "X-Session-Id": sess})
             out = json.loads(raw)
             if st != 200 or not out.get("accepted") \
                     or out.get("applied_count") != delta_size \
                     or out.get("trace_id") != tid:
                 errors.append(f"push {st}: {out}")
                 return
+        # read-your-writes over the wire (ISSUE 6): every delta above
+        # was acked AFTER its commit's snapshot published, so this
+        # read MUST reflect all of them — and the new correlation
+        # headers identify exactly which snapshot answered
+        st, raw, resp = req_full("GET", f"/docs/{doc_id}",
+                                 headers={"X-Session-Id": sess})
+        if st != 200:
+            errors.append(f"ryw read -> {st}")
+            return
+        served = set(json.loads(raw)["values"])
+        missing_vals = [v for v in own_values if v not in served]
+        if missing_vals:
+            errors.append(
+                f"{doc_id} r{rid}: read missed own acked writes "
+                f"{missing_vals[:3]}")
+        seq_hdr = resp.getheader("X-Commit-Seq")
+        if seq_hdr is None or not resp.getheader(
+                "X-Snapshot-Fingerprint"):
+            errors.append(f"{doc_id} r{rid}: missing read trace headers")
+        elif resp.getheader("X-Session-Id") != sess:
+            errors.append(f"{doc_id} r{rid}: session id not adopted")
 
     def reader(doc_id):
         while not stop_readers.is_set():
@@ -171,19 +207,16 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     # flight recorder: every commit record carries ≥1 trace id, and the
     # records' union covers every id the pushes carried.  Records land
     # ASYNCHRONOUSLY after the ticket resolves (the scheduler appends
-    # them after done.set()), so poll until coverage is complete before
-    # asserting — a one-shot scrape can race the final record.
-    deadline = time.time() + 30.0
-    while True:
-        st, raw = req("GET", "/debug/flight")
-        assert st == 200, st
-        flight = json.loads(raw)
-        seen_ids = set()
-        for r in flight["records"]:
-            seen_ids.update(r["trace_ids"])
-        if not (pushed_trace_ids - seen_ids) or time.time() > deadline:
-            break
-        time.sleep(0.2)
+    # them after done.set()) — the flush barrier (ServingEngine.flush,
+    # ISSUE 6) joins the scheduler up to this point WITHOUT closing
+    # it, so one scrape suffices where a records_total poll used to.
+    assert srv.store.flush(timeout=30), "scheduler flush timed out"
+    st, raw = req("GET", "/debug/flight")
+    assert st == 200, st
+    flight = json.loads(raw)
+    seen_ids = set()
+    for r in flight["records"]:
+        seen_ids.update(r["trace_ids"])
     assert flight["records"], "no flight records"
     for r in flight["records"]:
         assert r["trace_ids"], f"flight record {r['seq']} untraced"

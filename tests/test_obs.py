@@ -284,7 +284,15 @@ def test_soak_every_commit_record_carries_admission_trace_ids(tmp_path):
         t.start()
     for t in threads:
         t.join(60)
-    engine.close()     # joins the scheduler: all records are flushed
+    # the flush barrier replaces close()-as-barrier: records are
+    # guaranteed recorded, and the engine KEEPS serving afterwards.
+    # close() before asserting — a failure must not leak a live
+    # scheduler thread into the rest of the test session
+    try:
+        flushed = engine.flush(timeout=60)
+    finally:
+        engine.close()
+    assert flushed
     assert not errors, errors[:5]
 
     records = rec.records()
@@ -489,7 +497,10 @@ def test_http_prom_and_flight_endpoints(server, req):
              fams["crdt_span_ms_total"]["samples"]}
     assert {"serve.parse", "serve.merge", "serve.publish"} <= spans
 
-    # flight debug endpoint: both commits, trace ids attached
+    # flight debug endpoint: both commits, trace ids attached.  Records
+    # land asynchronously after the POST returns — the flush barrier
+    # (not a records_total poll) makes the one-shot scrape safe.
+    assert server.store.flush(timeout=30)
     st, flight = req(server, "GET", "/debug/flight")
     assert st == 200
     recs = flight["records"]

@@ -1,0 +1,615 @@
+"""Online session-guarantee oracle (ISSUE 6): per-guarantee unit
+checks, seeded fault injection through the real engine, the read-path
+correlation headers, the flush barrier, and the tier-1 closed-loop
+smoke.
+
+Acceptance pins:
+
+- each guarantee check flags an injected violation and stays silent on
+  a clean history;
+- every ``GRAFT_ORACLE_FAULT`` kind (stale-snapshot, dropped-ack,
+  fingerprint-regression) is caught by the oracle AND trips the
+  ``oracle`` flight-dump trigger exactly once;
+- a small closed-loop run against the real HTTP server reports zero
+  violations and exercises ≥1 genuinely coalesced multi-writer commit;
+- ``/metrics/prom`` strict-parses with the ``crdt_oracle_*`` families
+  when an oracle is attached.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.codec import json_codec                   # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch          # noqa: E402
+from crdt_graph_tpu.obs import flight as flight_mod           # noqa: E402
+from crdt_graph_tpu.obs import oracle as oracle_mod           # noqa: E402
+from crdt_graph_tpu.obs import prom as prom_mod               # noqa: E402
+from crdt_graph_tpu.obs.trace import (COMMIT_SEQ_HEADER,      # noqa: E402
+                                      SESSION_HEADER, SNAP_FP_HEADER,
+                                      TRACE_HEADER)
+from crdt_graph_tpu.serve import ServingEngine                # noqa: E402
+
+OFFSET = 2**32
+
+
+def chain_ops(rid, n, counter0=0, anchor=0):
+    ops, prev = [], anchor
+    for i in range(n):
+        ts = rid * OFFSET + counter0 + i + 1
+        ops.append(Add(ts, (prev,), (counter0 + i) & 0xFF))
+        prev = ts
+    return ops
+
+
+def chain_body(rid, n, counter0=0, anchor=0):
+    return json_codec.dumps(Batch(tuple(chain_ops(rid, n, counter0,
+                                                  anchor))))
+
+
+def mk_recorder(tmp_path, **kw):
+    kw.setdefault("capacity", 256)
+    kw.setdefault("slo_ms", 60_000.0)
+    kw.setdefault("audit_every", 0)
+    kw.setdefault("dump_dir", str(tmp_path))
+    kw.setdefault("min_dump_interval_s", 0.0)
+    return flight_mod.FlightRecorder(**kw)
+
+
+def commit_rec(doc_id="d", trace_ids=("trace-000001",), seq=1,
+               fp="fp1", outcome="committed", width=1):
+    return {"doc_id": doc_id, "trace_ids": list(trace_ids),
+            "outcome": outcome, "snapshot_seq": seq, "fingerprint": fp,
+            "coalesce_width": width}
+
+
+# -- per-guarantee unit checks (pure oracle, no engine) --------------------
+
+
+def test_clean_history_is_silent():
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "trace-000001")
+    o.ingest_commit_record(commit_rec(seq=1, fp="fp1"))
+    o.observe_read("sess-0001", "d", 1, "fp1")
+    o.observe_final_read("sess-0001", "d", 1, "fp1")
+    assert o.finalize() == []
+    st = o.stats()
+    assert st["violations_total"] == 0
+    assert st["pending_writes"] == 0
+    # every check family actually evaluated something
+    assert all(st["checks"][k] >= 1 for k in oracle_mod.CHECKS)
+
+
+def test_read_your_writes_violation_after_resolution():
+    """The commit record resolved the write to seq 2 BEFORE the read:
+    a read at seq 1 is flagged immediately."""
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "trace-000001")
+    o.ingest_commit_record(commit_rec(seq=2, fp="fp2"))
+    o.observe_read("sess-0001", "d", 1, "fp1")
+    (v,) = o.violations
+    assert v["check"] == "read_your_writes"
+    assert v["seq"] == 1 and v["required_seq"] == 2
+
+
+def test_read_your_writes_parked_read_resolves_late():
+    """The read lands before the commit record (the async-record
+    reality): it is parked and condemned on resolution."""
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "trace-000001")
+    o.observe_read("sess-0001", "d", 1, "fp1")       # parked, no verdict
+    assert o.stats()["violations_total"] == 0
+    assert o.stats()["pending_writes"] == 1
+    o.ingest_commit_record(commit_rec(seq=2, fp="fp2"))
+    (v,) = o.violations
+    assert v["check"] == "read_your_writes"
+    assert v["trace_id"] == "trace-000001"
+    assert o.stats()["pending_writes"] == 0
+
+
+def test_monotonic_read_regression_and_fork():
+    o = oracle_mod.SessionOracle()
+    o.observe_read("sess-0001", "d", 2, "fp2")
+    o.observe_read("sess-0001", "d", 1, "fp1")       # seq regressed
+    o.observe_read("sess-0002", "d", 3, "fpA")
+    o.observe_read("sess-0002", "d", 3, "fpB")       # forked at same seq
+    kinds = [v["check"] for v in o.violations]
+    assert kinds == ["monotonic_read", "monotonic_read"]
+    # per-session isolation: a third session at seq 1 is fine
+    o.observe_read("sess-0003", "d", 1, "fp1")
+    assert o.stats()["violations"]["monotonic_read"] == 2
+
+
+def test_fingerprint_cross_check_against_flight_stream():
+    o = oracle_mod.SessionOracle()
+    o.ingest_commit_record(commit_rec(seq=5, fp="flightfp"))
+    o.observe_read("sess-0001", "d", 5, "readfp")
+    (v,) = o.violations
+    assert v["check"] == "fingerprint_match"
+    assert v["flight_fingerprint"] == "flightfp"
+
+
+def test_fingerprintless_read_does_not_poison_next_seq():
+    """A fingerprint-less read at a NEW seq must not carry the prior
+    seq's fingerprint forward — the next fingerprinted read at the new
+    seq is not a fork.  Same-seq retention still catches real forks."""
+    o = oracle_mod.SessionOracle()
+    o.observe_read("sess-0001", "d", 5, "fpA")
+    o.observe_read("sess-0001", "d", 6, None)        # headerless read
+    o.observe_read("sess-0001", "d", 6, "fpB")       # NOT a fork
+    assert o.stats()["violations_total"] == 0
+    o.observe_read("sess-0001", "d", 6, None)        # same seq: fpB kept
+    o.observe_read("sess-0001", "d", 6, "fpC")       # genuine fork
+    assert [v["check"] for v in o.violations] == ["monotonic_read"]
+
+
+def test_noop_record_resolves_empty_acked_write():
+    """An acked EMPTY delta lands on a "noop" record that publishes no
+    new snapshot: the ack resolves with NO read floor — not a
+    dropped_ack at finalize.  Both arrival orders are legal."""
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "trace-000001")
+    o.ingest_commit_record(commit_rec(outcome="noop"))
+    assert o.stats()["pending_writes"] == 0
+    o.observe_read("sess-0001", "d", 0, None)        # no floor imposed
+    assert o.finalize() == []
+    o2 = oracle_mod.SessionOracle()                  # record beats ack
+    o2.ingest_commit_record(commit_rec(outcome="noop"))
+    o2.observe_write_ack("sess-0001", "d", "trace-000001")
+    assert o2.stats()["pending_writes"] == 0 and o2.finalize() == []
+
+
+def test_colliding_client_trace_ids_resolve_all_owners():
+    """The HTTP layer adopts any well-formed client trace id, so
+    sessions may collide on one: every owner must resolve when its
+    doc's record lands — no silent shadowing, no false dropped_ack."""
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "shared-trace-01")
+    o.observe_write_ack("sess-0002", "d", "shared-trace-01")
+    o.observe_write_ack("sess-0003", "e", "shared-trace-01")  # other doc
+    o.ingest_commit_record(commit_rec(
+        doc_id="d", trace_ids=("shared-trace-01",), seq=3, fp="fp3"))
+    assert o.stats()["pending_writes"] == 1   # only the doc-e ack left
+    o.ingest_commit_record(commit_rec(
+        doc_id="e", trace_ids=("shared-trace-01",), seq=1, fp="fpE"))
+    assert o.stats()["pending_writes"] == 0
+    o.observe_read("sess-0001", "d", 3, "fp3")
+    o.observe_read("sess-0002", "d", 3, "fp3")
+    assert o.finalize() == []
+
+
+def test_resolved_history_is_bounded():
+    """An oracle on a long-running engine must not grow with total
+    commits: resolved traces and fingerprint history evict FIFO."""
+    o = oracle_mod.SessionOracle(max_resolved_traces=10,
+                                 max_fp_entries=10)
+    for i in range(50):
+        o.ingest_commit_record(commit_rec(
+            trace_ids=(f"trace-{i:06d}",), seq=i + 1, fp=f"fp{i}"))
+    assert len(o._trace_commits) <= 10
+    assert len(o._fp_by_seq) <= 10
+    assert o.stats()["violations_total"] == 0
+    # session churn is bounded too, while the distinct-session counter
+    # stays monotonic (it feeds crdt_oracle_sessions_total)
+    o2 = oracle_mod.SessionOracle(max_session_states=8)
+    for i in range(40):
+        o2.observe_read(f"sess-{i:04d}", "d", 1, "fp1")
+    assert len(o2._sessions) <= 8 and len(o2._session_ids) <= 8
+    assert o2.stats()["sessions"] == 40
+    assert o2.stats()["violations_total"] == 0
+
+
+def test_dropped_ack_flagged_at_finalize_only():
+    o = oracle_mod.SessionOracle()
+    o.observe_write_ack("sess-0001", "d", "trace-000001")
+    assert o.stats()["violations_total"] == 0        # online: not yet
+    vs = o.finalize()
+    assert [v["check"] for v in vs] == ["dropped_ack"]
+    assert vs[0]["trace_id"] == "trace-000001"
+
+
+def test_convergence_mismatch_across_sessions():
+    o = oracle_mod.SessionOracle()
+    o.observe_final_read("sess-0001", "d", 4, "fp4")
+    o.observe_final_read("sess-0002", "d", 4, "fp4x")
+    vs = o.finalize()
+    assert [v["check"] for v in vs] == ["convergence"]
+
+
+def test_violation_fires_oracle_dump_with_rate_limit(tmp_path):
+    rec = mk_recorder(tmp_path, min_dump_interval_s=60.0)
+    rec.record({  # something in the ring so the dump carries context
+        "doc_id": "d", "trace_ids": ("t" * 16,), "outcome": "committed",
+        "num_ops": 1, "applied_ops": 1, "dup_ops": 0,
+        "coalesce_width": 1, "chunk_count": 1,
+        "queue_depth_admission": 0, "stages_ms": {}, "total_ms": 0.1,
+        "staleness_s": None, "snapshot_seq": 1, "fingerprint": "f",
+        "audit": None, "error": None})
+    o = oracle_mod.SessionOracle(flight=rec)
+    o.observe_read("sess-0001", "d", 2, "fp2")
+    o.observe_read("sess-0001", "d", 1, "fp1")       # violation → dump
+    o.observe_read("sess-0001", "d", 0, "fp0")       # rate-limited
+    st = rec.stats()
+    assert st["dumps"] == {"oracle": 1, "suppressed": 1}
+    path = st["last_dump_path"]
+    assert path.endswith("_oracle.jsonl")
+    lines = [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert lines[0]["reason"] == "oracle" and len(lines) == 2
+
+
+# -- fault injection through the real engine -------------------------------
+
+
+def test_fault_injector_env_parse_and_one_shot(monkeypatch):
+    monkeypatch.delenv("GRAFT_ORACLE_FAULT", raising=False)
+    assert oracle_mod.FaultInjector.from_env() is None
+    monkeypatch.setenv("GRAFT_ORACLE_FAULT", "stale, drop,bogus")
+    inj = oracle_mod.FaultInjector.from_env()
+    assert inj.armed("stale") and inj.armed("drop")
+    assert not inj.armed("bogus") and not inj.armed("regress")
+    assert inj.pop("stale") and not inj.pop("stale")   # one-shot
+    # regress burns one skip before firing (the eligible read that
+    # must still see the CURRENT snapshot)
+    inj2 = oracle_mod.FaultInjector(("regress",))
+    assert not inj2.pop("regress") and inj2.pop("regress")
+    assert not inj2.pop("regress")
+
+
+def oracle_engine(tmp_path, fault_kinds):
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(
+        flight=rec, fault=oracle_mod.FaultInjector(fault_kinds))
+    o = oracle_mod.SessionOracle()
+    o.attach_engine(engine)
+    return engine, o, rec
+
+
+def test_fault_stale_snapshot_caught(tmp_path):
+    """An injected stale read (the previous published snapshot) is a
+    read-your-writes violation — caught via trace_id → CommitRecord →
+    seq correlation, and it trips the oracle dump exactly once."""
+    engine, o, rec = oracle_engine(tmp_path, ("stale",))
+    try:
+        for w in range(2):
+            tid = f"stale-w{w:04d}"
+            acc, _ = engine.submit("d", chain_body(
+                1, 6, counter0=6 * w,
+                anchor=(OFFSET + 6 * w) if w else 0), trace_id=tid)
+            assert acc
+            o.observe_write_ack("sess-0001", "d", tid)
+        assert engine.flush(timeout=30)
+        snap = engine.get("d").read_view()         # fault fires: prev
+        assert snap.seq == 1
+        o.observe_read("sess-0001", "d", snap.seq, snap.fingerprint())
+        (v,) = o.violations
+        assert v["check"] == "read_your_writes"
+        assert v["seq"] == 1 and v["required_seq"] == 2
+        assert engine.fault.fired == {"stale": 1}
+        assert rec.stats()["dumps"].get("oracle") == 1
+        # the fault is one-shot: the next read serves the real snapshot
+        assert engine.get("d").read_view().seq == 2
+    finally:
+        o.detach_engine(engine)
+        engine.close()
+
+
+def test_fault_fingerprint_regression_caught(tmp_path):
+    """An injected regression (current snapshot observed, then the
+    previous one served) is a monotonic-read violation."""
+    engine, o, rec = oracle_engine(tmp_path, ("regress",))
+    try:
+        for w in range(2):
+            acc, _ = engine.submit("d", chain_body(
+                1, 6, counter0=6 * w,
+                anchor=(OFFSET + 6 * w) if w else 0))
+            assert acc
+        assert engine.flush(timeout=30)
+        doc = engine.get("d")
+        s1 = doc.read_view()                       # skip burn: current
+        assert s1.seq == 2
+        o.observe_read("sess-0001", "d", s1.seq, s1.fingerprint())
+        s2 = doc.read_view()                       # fault fires: prev
+        assert s2.seq == 1
+        o.observe_read("sess-0001", "d", s2.seq, s2.fingerprint())
+        (v,) = o.violations
+        assert v["check"] == "monotonic_read"
+        assert v["seq"] == 1 and v["prev_seq"] == 2
+        assert rec.stats()["dumps"].get("oracle") == 1
+    finally:
+        o.detach_engine(engine)
+        engine.close()
+
+
+def test_fault_dropped_ack_caught(tmp_path):
+    """An injected dropped ack (ticket acked, publish + record
+    skipped) is invisible online and condemned at finalize."""
+    engine, o, rec = oracle_engine(tmp_path, ("drop",))
+    try:
+        acc, _ = engine.submit("d", chain_body(1, 6),
+                               trace_id="drop-w0000")
+        assert acc                                  # acked regardless
+        o.observe_write_ack("sess-0001", "d", "drop-w0000")
+        assert engine.flush(timeout=30)
+        assert rec.stats()["records_total"] == 0    # record suppressed
+        assert engine.get("d").snapshot_view().seq == 0   # no publish
+        assert engine.counters.get("fault_dropped_commits") == 1
+        vs = o.finalize()
+        assert [v["check"] for v in vs] == ["dropped_ack"]
+        assert vs[0]["trace_id"] == "drop-w0000"
+        assert rec.stats()["dumps"].get("oracle") == 1
+    finally:
+        o.detach_engine(engine)
+        engine.close()
+
+
+def test_fault_stale_over_http_via_read_headers(tmp_path, monkeypatch):
+    """End-to-end fault proof: GRAFT_ORACLE_FAULT=stale in the env, a
+    real server, and the oracle fed ONLY from wire-observable evidence
+    (ack echoes, read headers, /debug/flight)."""
+    import http.client
+    from crdt_graph_tpu.service import make_server
+    monkeypatch.setenv("GRAFT_ORACLE_FAULT", "stale")
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(flight=rec)        # fault read from env
+    o = oracle_mod.SessionOracle()
+    srv = make_server(port=0, store=engine)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.server_port,
+                                          timeout=30)
+        for w in range(2):
+            tid = f"http-w{w:04d}"
+            conn.request("POST", "/docs/h/ops", body=chain_body(
+                1, 5, counter0=5 * w,
+                anchor=(OFFSET + 5 * w) if w else 0),
+                headers={TRACE_HEADER: tid,
+                         SESSION_HEADER: "sess-http1"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200 and out["accepted"]
+            assert resp.getheader(SESSION_HEADER) == "sess-http1"
+            o.observe_write_ack("sess-http1", "h", tid)
+        assert engine.flush(timeout=30)
+        # feed the oracle from the wire-side flight scrape (the
+        # polling-free path: flush already guaranteed the records)
+        conn.request("GET", "/debug/flight")
+        for r in json.loads(conn.getresponse().read())["records"]:
+            o.ingest_commit_record(r)
+        conn.request("GET", "/docs/h",
+                     headers={SESSION_HEADER: "sess-http1"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        seq = int(resp.getheader(COMMIT_SEQ_HEADER))
+        fp = resp.getheader(SNAP_FP_HEADER)
+        assert seq == 1 and len(body["values"]) == 5   # the stale view
+        o.observe_read("sess-http1", "h", seq, fp)
+        conn.close()
+        (v,) = o.violations
+        assert v["check"] == "read_your_writes"
+        assert v["required_seq"] == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        engine.close()
+
+
+# -- read-path headers on a clean server -----------------------------------
+
+
+def test_read_headers_echo_snapshot_identity(server):
+    """GET /docs/{id} and /snapshot carry the served snapshot's
+    fingerprint + seq and adopt (or mint) X-Session-Id; the
+    fingerprint matches the commit's flight record."""
+    import http.client
+    engine = server.store
+    conn = http.client.HTTPConnection("127.0.0.1", server.server_port,
+                                      timeout=30)
+    conn.request("POST", "/docs/hdr/ops", body=chain_body(1, 7))
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.status == 200
+    assert engine.flush(timeout=30)
+    conn.request("GET", "/docs/hdr",
+                 headers={SESSION_HEADER: "sess-hdr-1"})
+    resp = conn.getresponse()
+    resp.read()
+    seq = int(resp.getheader(COMMIT_SEQ_HEADER))
+    fp = resp.getheader(SNAP_FP_HEADER)
+    assert seq == 1 and fp
+    assert resp.getheader(SESSION_HEADER) == "sess-hdr-1"
+    (rec,) = engine.flight.records()
+    assert rec.snapshot_seq == seq and rec.fingerprint == fp
+    # /snapshot serves the same identity; malformed session re-minted
+    conn.request("GET", "/docs/hdr/snapshot",
+                 headers={SESSION_HEADER: "bad id!"})
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.getheader(SNAP_FP_HEADER) == fp
+    assert int(resp.getheader(COMMIT_SEQ_HEADER)) == seq
+    minted = resp.getheader(SESSION_HEADER)
+    assert minted and minted != "bad id!"
+    conn.close()
+
+
+# -- the flush barrier -----------------------------------------------------
+
+
+def test_flush_barrier_replaces_record_polling(tmp_path):
+    """flush() returns only after every prior ticket's flight record
+    has landed — and leaves the engine serving (unlike close())."""
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(flight=rec)
+    try:
+        for w in range(3):
+            engine.submit("f", chain_body(1, 4, counter0=4 * w,
+                                          anchor=(OFFSET + 4 * w)
+                                          if w else 0))
+            assert engine.flush(timeout=30)
+            assert rec.stats()["records_total"] == w + 1   # no polling
+        # engine still alive after the barrier
+        acc, _ = engine.submit("f", chain_body(
+            1, 4, counter0=12, anchor=OFFSET + 12))
+        assert acc
+        # a paused scheduler with pending work times out (False)
+        engine.scheduler.pause()
+        try:
+            t = threading.Thread(
+                target=lambda: engine.submit(
+                    "f", chain_body(1, 4, counter0=16,
+                                    anchor=OFFSET + 16)),
+                daemon=True)
+            t.start()
+            deadline = 100
+            while not len(engine.get("f").queue) and deadline:
+                deadline -= 1
+                threading.Event().wait(0.01)
+            assert engine.flush(timeout=0.3) is False
+        finally:
+            engine.scheduler.resume()
+            t.join(30)
+    finally:
+        engine.close()
+
+
+def test_flush_refuses_on_stopping_scheduler(tmp_path):
+    """A stopping scheduler fails its pending tickets WITHOUT flight
+    records — flush() must refuse (False) immediately, not report the
+    barrier held and not burn its whole timeout."""
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(flight=rec)
+    try:
+        engine.scheduler.pause()
+        t = threading.Thread(
+            target=lambda: engine.submit("f", chain_body(1, 4)),
+            daemon=True)
+        t.start()
+        deadline = 100
+        while not len(engine.get("f").queue) and deadline:
+            deadline -= 1
+            time.sleep(0.01)
+        with engine.scheduler.cond:
+            engine.scheduler._stop_requested = True
+        t0 = time.monotonic()
+        assert engine.flush(timeout=5) is False
+        assert time.monotonic() - t0 < 2.0   # refused, not timed out
+        with engine.scheduler.cond:
+            engine.scheduler._stop_requested = False
+        engine.scheduler.resume()
+        t.join(30)
+    finally:
+        engine.close()
+
+
+def test_prev_snapshot_not_retained_without_fault(tmp_path):
+    """Production engines (no fault injector) must not hold the
+    outgoing snapshot generation after publish — only fault injection
+    ever serves it (read_view)."""
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(flight=rec)
+    try:
+        engine.submit("m", chain_body(1, 3))
+        assert engine.flush(timeout=30)
+        doc = engine.get("m")
+        assert doc.snapshot_view().seq == 1
+        assert doc._prev_snap is None
+    finally:
+        engine.close()
+
+
+# -- prom exposition round-trip with the oracle families -------------------
+
+
+def test_prom_round_trip_includes_oracle_families(tmp_path):
+    rec = mk_recorder(tmp_path)
+    engine = ServingEngine(flight=rec)
+    o = oracle_mod.SessionOracle()
+    o.attach_engine(engine)
+    try:
+        engine.submit("p", chain_body(1, 5), trace_id="prom-w0000")
+        o.observe_write_ack("sess-prom1", "p", "prom-w0000")
+        assert engine.flush(timeout=30)
+        snap = engine.get("p").snapshot_view()
+        o.observe_read("sess-prom1", "p", snap.seq, snap.fingerprint())
+        o.observe_read("sess-prom1", "p", snap.seq - 1, None)  # inject
+        fams = prom_mod.parse_text(engine.render_prom())
+        for fam in ("crdt_oracle_sessions_total",
+                    "crdt_oracle_checks_total",
+                    "crdt_oracle_violations_total",
+                    "crdt_oracle_commits_ingested_total",
+                    "crdt_oracle_pending_writes"):
+            assert fam in fams, fam
+        viol = {lbl["check"]: v for _, lbl, v in
+                fams["crdt_oracle_violations_total"]["samples"]}
+        assert set(viol) == set(oracle_mod.CHECKS)   # full label set
+        # the injected regressed read trips BOTH session guarantees:
+        # it reads below the session's resolved write AND regresses
+        assert viol["read_your_writes"] == 1.0
+        assert viol["monotonic_read"] == 1.0
+        assert viol["dropped_ack"] == 0
+        checks = {lbl["check"]: v for _, lbl, v in
+                  fams["crdt_oracle_checks_total"]["samples"]}
+        assert checks["read_your_writes"] >= 2
+    finally:
+        o.detach_engine(engine)
+        engine.close()
+
+
+# -- the tier-1 closed-loop smoke ------------------------------------------
+
+
+def test_loadgen_smoke_zero_violations(tmp_path):
+    """Small closed-loop run against the real HTTP server: zero
+    violations, ≥1 genuinely coalesced multi-writer commit, shedding
+    and the giant racer exercised, prom families present."""
+    from crdt_graph_tpu.bench import loadgen
+    rec = mk_recorder(tmp_path, capacity=4096)
+    engine = ServingEngine(flight=rec, max_queue_requests=4)
+    cfg = loadgen.LoadgenConfig(
+        n_sessions=10, n_docs=2, writes_per_session=5, delta_size=8,
+        max_queue_requests=4, giant_ops=2000, stage_first_round=True,
+        seed=2)
+    try:
+        res = loadgen.run(cfg, engine=engine)
+    finally:
+        engine.close()
+    assert not res["errors"], res["errors"]
+    assert res["oracle"]["violations_total"] == 0
+    assert res["violations"] == []
+    # a genuinely coalesced multi-writer commit happened (the staged
+    # first round guarantees it deterministically)
+    assert res["staged_first_round"]
+    assert res["oracle"]["max_coalesce_width"] >= 2
+    assert res["writes_acked"] == 10 * 5 + 1         # + the giant
+    assert res["ops_merged"] == res["leaves_acked"]  # nothing lost
+    assert res["reads"] >= 10 and res["read_p99_ms"] is not None
+    assert res["flushed"]
+    assert res["oracle"]["pending_writes"] == 0      # every ack resolved
+    assert "crdt_oracle_violations_total" in res["prom_oracle_families"]
+    # the flight stream fed the oracle without any records_total polling
+    assert res["oracle"]["commits_ingested"] >= 2
+
+
+@pytest.mark.slow
+def test_serve_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_SERVE_r01_cpu.json shape):
+    ≥200 sessions, ≥50k leaves, zero violations.  Slow-marked — the
+    tier-1 gate runs the small smoke above instead."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bench_serve_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_serve_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_SERVE_test.json"))
+    assert out["violations_total"] == 0
+    assert out["sessions"] >= 200 and out["total_leaves"] >= 50_000
+    assert not out["report"]["errors"]
+    assert out["report"]["oracle"]["max_coalesce_width"] >= 2
