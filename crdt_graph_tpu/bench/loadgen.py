@@ -41,7 +41,7 @@ import json
 import random
 import threading
 import time
-from http.client import HTTPConnection
+from http.client import HTTPConnection, HTTPException
 from typing import Any, Dict, List, Optional
 
 from ..codec import json_codec
@@ -71,6 +71,16 @@ class LoadgenConfig:
     stage_first_round: bool = True
     read_timeout_s: float = 120.0
     seed: int = 0
+    # -- fleet mode (ISSUE 7; run_fleet) ---------------------------------
+    n_servers: int = 1             # >1 = in-process replica fleet
+    lease_ttl_s: float = 3.0
+    ae_interval_s: float = 0.1
+    delta_cap: int = 8192          # anti-entropy window cap (leaves)
+    kill_mid_run: bool = False     # crash the giant's primary mid-merge
+    restart_killed: bool = True    # then rejoin it under the same name
+    lag_probe_every: int = 4       # every Nth acked write measures
+    #                                ack→visible-on-another-replica lag
+    spray_read_p: float = 0.5      # extra read via a random replica
 
 
 class _Session(threading.Thread):
@@ -407,12 +417,662 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     return out
 
 
+# -- fleet mode (ISSUE 7) ---------------------------------------------------
+#
+# ``run_fleet`` drives an in-process replica fleet (N FleetServers on
+# their own localhost ports sharing one MemoryKV) instead of one
+# server: sessions enter through a home server (the gateway forwards
+# writes to each document's primary), spray reads across replicas
+# (every read observed under the oracle key ``doc@replica.epoch``, so
+# monotonic reads are checked per replica INCARNATION — a restarted
+# server's fresh seq counter must not read as a regression), probe
+# read-your-writes through the committing primary, and sample
+# anti-entropy lag by timing ack → visible-on-another-replica.  With
+# ``kill_mid_run`` the giant doc's primary is crashed mid-merge (no
+# lease release), the giant re-pushes through a survivor once failover
+# reroutes the doc, and the server rejoins under its old name with a
+# bumped fencing epoch.  At quiescence every live replica's
+# replica-independent state fingerprint feeds the oracle's
+# cross-replica convergence check.
+
+
+class _FleetHarness:
+    def __init__(self, cfg: LoadgenConfig,
+                 oracle: oracle_mod.SessionOracle):
+        from ..cluster import MemoryKV
+        self.cfg = cfg
+        self.oracle = oracle
+        self.kv = MemoryKV()
+        self.servers: Dict[str, Any] = {}       # live name -> FleetServer
+        self.dead: List[str] = []
+        self.lock = threading.Lock()
+        self.acked_total = 0                    # kill-timing signal
+        self.lag_s: List[float] = []
+        self.lag_censored = 0                   # probes lost to deadline
+        self.read_ms_primary: List[float] = []
+        self.read_ms_replica: List[float] = []
+        self.errors: List[str] = []
+        self.kill_report: Dict[str, Any] = {}
+
+    # -- fleet lifecycle --------------------------------------------------
+
+    def spawn(self, name: str):
+        from ..cluster import FleetServer
+        from ..obs import flight as flight_mod
+        from ..serve import ServingEngine
+        engine = ServingEngine(
+            max_queue_requests=self.cfg.max_queue_requests,
+            flight=flight_mod.FlightRecorder())
+        fs = FleetServer(name, self.kv, engine=engine,
+                         ttl_s=self.cfg.lease_ttl_s,
+                         ae_interval_s=self.cfg.ae_interval_s,
+                         delta_cap=self.cfg.delta_cap)
+        node = fs.node
+
+        def listen(rec):
+            # commit records are observed under the per-incarnation
+            # doc key, matching how reads of this server are observed.
+            # The epoch is read at RECORD time, not spawn time: a
+            # mid-run lease re-acquisition (renewal missed under load)
+            # bumps the epoch in place, and acks/reads key on the
+            # bumped value — a frozen tag would orphan every later ack
+            self.oracle.ingest_commit_record(
+                {**rec,
+                 "doc_id": f"{rec['doc_id']}@{name}.{node.epoch()}"})
+
+        engine.flight.add_listener(listen)
+        with self.lock:
+            self.servers[name] = fs
+        return fs
+
+    def crash(self, name: str) -> None:
+        with self.lock:
+            fs = self.servers.pop(name)
+            self.dead.append(name)
+        fs.crash()
+
+    def live(self) -> List[Any]:
+        with self.lock:
+            return list(self.servers.values())
+
+    def primary_name(self, doc: str) -> Optional[str]:
+        for fs in self.live():
+            return fs.node.primary_for(doc)
+        return None
+
+    def wait_ring_stable(self, timeout_s: float = 15.0) -> None:
+        """Block until every live node's ring sees the whole fleet.
+        Nodes join one at a time, so a just-started node's cached ring
+        briefly contains only the members that had leases when IT
+        looked — a write entering through it then applies at a
+        not-yet-primary and the session's next write races
+        anti-entropy for its own anchors.  Real deployments converge
+        within one ring TTL of the last join; the harness must not
+        start traffic inside that window."""
+        want = len(self.live())
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(len(fs.node.refresh_ring()) == want
+                   for fs in self.live()):
+                return
+            time.sleep(0.02)
+        self.errors.append("fleet ring never stabilized")
+
+    # -- transport --------------------------------------------------------
+
+    def request(self, fs, method: str, path: str, body=None,
+                headers=None, timeout: float = 60.0):
+        conn = HTTPConnection("127.0.0.1", fs.port, timeout=timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            raw = resp.read()
+            return resp, raw
+        finally:
+            conn.close()
+
+    def observe_read(self, sid: str, doc: str, resp,
+                     final: bool = False) -> None:
+        seq = resp.getheader(COMMIT_SEQ_HEADER)
+        name = resp.getheader("X-Replica-Name")
+        epoch = resp.getheader("X-Replica-Epoch")
+        if seq is None or name is None:
+            self.errors.append(f"read of {doc} missing fleet headers")
+            return
+        key = f"{doc}@{name}.{epoch}"
+        ob = (self.oracle.observe_final_read if final
+              else self.oracle.observe_read)
+        ob(sid, key, int(seq), resp.getheader(SNAP_FP_HEADER))
+
+
+class _FleetSession(threading.Thread):
+    """One closed-loop fleet session: writes through a home entry
+    server (rotating to a survivor on connection failure, then
+    idempotently re-pushing its whole history so an acked-but-unsynced
+    write can never be lost with its primary), RYW probes through the
+    committing server, sprayed reads + lag probes on other replicas."""
+
+    def __init__(self, h: _FleetHarness, idx: int):
+        super().__init__(name=f"fleet-s{idx}", daemon=True)
+        self.h = h
+        self.idx = idx
+        cfg = h.cfg
+        self.sid = f"fsess-{idx:04d}"
+        self.doc = f"load{idx % cfg.n_docs}"
+        self.rng = random.Random(cfg.seed * 52361 + idx)
+        self.entry = h.live()[idx % len(h.live())].name
+        self.rid: Optional[int] = None
+        self.counter = 0
+        self.alive: List[int] = []
+        self.val_by_ts: Dict[int, str] = {}
+        self.deltas: List[str] = []       # encoded history (re-push)
+        self.writes_acked = 0
+        self.leaves_acked = 0
+        self.shed_429 = 0
+        self.retry_409 = 0
+        self.errors: List[str] = []
+
+    def _entry_server(self):
+        with self.h.lock:
+            fs = self.h.servers.get(self.entry)
+            if fs is None:                # entry died: rotate
+                names = sorted(self.h.servers)
+                if not names:
+                    return None
+                self.entry = names[self.idx % len(names)]
+                fs = self.h.servers[self.entry]
+        return fs
+
+    def _delta(self) -> Batch:
+        cfg = self.h.cfg
+        ops = []
+        for _ in range(cfg.delta_size):
+            if self.alive and self.rng.random() < cfg.backspace_p:
+                ops.append(Delete((self.alive.pop(),)))
+            else:
+                self.counter += 1
+                ts = self.rid * OFFSET + self.counter
+                anchor = self.alive[-1] if self.alive else 0
+                val = f"s{self.idx}:{self.counter}"
+                ops.append(Add(ts, (anchor,), val))
+                self.alive.append(ts)
+                self.val_by_ts[ts] = val
+        return Batch(tuple(ops))
+
+    def surviving_values(self) -> List[str]:
+        """Values acked AND never backspaced by this session — the set
+        the converged document must contain."""
+        return [self.val_by_ts[ts] for ts in self.alive]
+
+    def _post(self, body: str, tid: str):
+        """One write attempt chain: 429 backoff + 503 failover wait +
+        connection-failure entry rotation, bounded by the deadline.
+        Returns the ack dict or None (error recorded)."""
+        deadline = time.monotonic() + self.h.cfg.read_timeout_s
+        while time.monotonic() < deadline:
+            fs = self._entry_server()
+            if fs is None:
+                break
+            try:
+                resp, raw = self.h.request(
+                    fs, "POST", f"/docs/{self.doc}/ops", body=body,
+                    headers={TRACE_HEADER: tid,
+                             SESSION_HEADER: self.sid})
+            except (OSError, HTTPException):
+                self._rotate_and_repush()
+                continue
+            if resp.status == 200:
+                return json.loads(raw)
+            if resp.status == 429:
+                self.shed_429 += 1
+                time.sleep(min(float(
+                    resp.getheader("Retry-After") or 1), 0.05))
+                continue
+            if resp.status == 503:
+                # primary unreachable: wait out (part of) the lease
+                # TTL and retry — failover reroutes the doc
+                time.sleep(min(float(
+                    resp.getheader("Retry-After") or 1), 0.25))
+                continue
+            if resp.status == 409:
+                # causality gap AT THE CURRENT PRIMARY: our anchors
+                # were acked by an earlier primary and haven't synced
+                # (or died with it).  They exist in OUR history —
+                # re-push it in order through the entry (duplicates
+                # absorb), then retry; anti-entropy makes this
+                # transient, never a hard failure
+                self.retry_409 += 1
+                self._repush(fs)
+                time.sleep(0.05)
+                continue
+            self.errors.append(f"write -> {resp.status}: {raw[:120]!r}")
+            return None
+        self.errors.append("write never acked before deadline")
+        return None
+
+    def _rotate_and_repush(self) -> None:
+        """The entry server died under us: move to a survivor and
+        idempotently re-push the session's whole history (an acked
+        write whose primary died unsynced exists nowhere else — the
+        CRDT absorbs every duplicate, so replay is free of harm)."""
+        self.entry = "?"                  # force re-pick
+        fs = self._entry_server()
+        if fs is not None:
+            self._repush(fs)
+
+    def _repush(self, fs) -> None:
+        """Replay the session's whole delta history in order through
+        ``fs`` (each delta restores the anchors of the next; the CRDT
+        absorbs every duplicate)."""
+        for k, body in enumerate(self.deltas):
+            try:
+                self.h.request(
+                    fs, "POST", f"/docs/{self.doc}/ops", body=body,
+                    headers={TRACE_HEADER:
+                             f"{self.sid}-rp{k:04d}-{self.rng.randrange(16**4):04x}",
+                             SESSION_HEADER: self.sid})
+            except (OSError, HTTPException):
+                return                    # next _post attempt rotates
+
+    def _read_via(self, fs, final: bool = False,
+                  probe_value: Optional[str] = None) -> bool:
+        t0 = time.perf_counter()
+        try:
+            resp, raw = self.h.request(
+                fs, "GET", f"/docs/{self.doc}",
+                headers={SESSION_HEADER: self.sid})
+        except (OSError, HTTPException):
+            return False
+        ms = (time.perf_counter() - t0) * 1e3
+        if resp.status == 404:
+            return False                  # not yet synced to this node
+        if resp.status != 200:
+            self.errors.append(f"read -> {resp.status}")
+            return False
+        primary = self.h.primary_name(self.doc)
+        served = resp.getheader("X-Replica-Name")
+        (self.h.read_ms_primary if served == primary
+         else self.h.read_ms_replica).append(ms)
+        self.h.observe_read(self.sid, self.doc, resp, final=final)
+        if probe_value is not None:
+            return probe_value in json.loads(raw).get("values", [])
+        return True
+
+    def _lag_probe(self, committed_on: str, value: str,
+                   t_ack: float) -> None:
+        """Time ack → visible on a replica OTHER than the committing
+        one: the client-observed anti-entropy lag.  The target is
+        re-picked per attempt (it may be the server the killer just
+        crashed); a probe that outlives the deadline is CENSORED — a
+        latency sample lost to contention, not a sync failure, which
+        the quiescence convergence + acked-value checks still cover."""
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            others = [fs for fs in self.h.live()
+                      if fs.name != committed_on]
+            if not others:
+                return
+            target = self.rng.choice(others)
+            if self._read_via(target, probe_value=value):
+                self.h.lag_s.append(time.monotonic() - t_ack)
+                return
+            time.sleep(0.02)
+        with self.h.lock:
+            self.h.lag_censored += 1
+
+    def _allocate_replica(self) -> Optional[int]:
+        """Claim a fleet-unique replica id through ANY live server —
+        rotating off an entry that dies mid-allocation (the killer may
+        fire while sessions are still starting up)."""
+        deadline = time.monotonic() + self.h.cfg.read_timeout_s
+        while time.monotonic() < deadline:
+            fs = self._entry_server()
+            if fs is None:
+                return None
+            try:
+                resp, raw = self.h.request(
+                    fs, "POST", f"/docs/{self.doc}/replicas",
+                    timeout=30)
+            except (OSError, HTTPException):
+                self.entry = "?"            # re-pick a survivor
+                time.sleep(0.1)
+                continue
+            if resp.status == 200:
+                return json.loads(raw)["replica"]
+            time.sleep(0.2)
+        return None
+
+    def run(self) -> None:
+        try:
+            self.rid = self._allocate_replica()
+            if self.rid is None:
+                self.errors.append("replica id never allocated")
+                return
+            cfg = self.h.cfg
+            for w in range(cfg.writes_per_session):
+                delta = self._delta()
+                body = json_codec.dumps(delta)
+                self.deltas.append(body)
+                tid = f"{self.sid}-w{w:04d}"
+                ack = self._post(body, tid)
+                if ack is None:
+                    return
+                if not ack.get("accepted"):
+                    self.errors.append(f"bad ack: {ack}")
+                    return
+                served = ack.get("served_by") or {}
+                akey = (f"{self.doc}@{served.get('name')}."
+                        f"{served.get('epoch')}")
+                self.h.oracle.observe_write_ack(self.sid, akey,
+                                                ack["trace_id"])
+                t_ack = time.monotonic()
+                self.writes_acked += 1
+                self.leaves_acked += len(delta.ops)
+                with self.h.lock:
+                    self.h.acked_total += 1
+                # RYW probe through the COMMITTING server (the one
+                # place the guarantee holds pre-sync)
+                with self.h.lock:
+                    committer = self.h.servers.get(served.get("name"))
+                if committer is not None:
+                    self._read_via(committer)
+                # sprayed replica-local read (staleness is legal and
+                # wire-observable; monotonicity must hold per replica)
+                if self.rng.random() < cfg.spray_read_p:
+                    self._read_via(self.rng.choice(self.h.live()))
+                if cfg.lag_probe_every and self.alive \
+                        and (w + 1) % cfg.lag_probe_every == 0:
+                    # probe an add that SURVIVED its own delta (a
+                    # backspaced value legitimately never appears)
+                    self._lag_probe(served.get("name"),
+                                    self.val_by_ts[self.alive[-1]],
+                                    t_ack)
+        except Exception as e:      # noqa: BLE001 — harness boundary
+            self.errors.append(repr(e))
+
+
+def run_fleet(cfg: Optional[LoadgenConfig] = None) -> Dict[str, Any]:
+    """One oracle-checked closed-loop run against an in-process
+    replica fleet.  Returns the fleet report (headline: distinct
+    acked leaves/sec, reader p99 on non-primary replicas, anti-entropy
+    lag p50/p99, oracle verdict, kill/failover outcome)."""
+    cfg = cfg or LoadgenConfig(n_servers=3)
+    assert cfg.n_servers >= 2, "fleet mode needs n_servers >= 2"
+    oracle = oracle_mod.SessionOracle()
+    h = _FleetHarness(cfg, oracle)
+    for i in range(cfg.n_servers):
+        h.spawn(f"n{i}")
+    h.wait_ring_stable()
+    sessions = [_FleetSession(h, i) for i in range(cfg.n_sessions)]
+    t_start = time.perf_counter()
+    giant_thread = killer_thread = None
+    giant_state: Dict[str, Any] = {}
+    try:
+        for s in sessions:
+            s.start()
+        if cfg.giant_ops:
+            giant_thread = threading.Thread(
+                target=_fleet_giant, args=(h, giant_state), daemon=True)
+            giant_thread.start()
+        if cfg.kill_mid_run:
+            killer_thread = threading.Thread(
+                target=_fleet_killer, args=(h, giant_state),
+                daemon=True)
+            killer_thread.start()
+        for s in sessions:
+            s.join(600)
+        if giant_thread is not None:
+            giant_thread.join(600)
+        if killer_thread is not None:
+            killer_thread.join(600)
+        load_wall_s = time.perf_counter() - t_start
+        report = _fleet_quiesce(h, sessions, giant_state, load_wall_s)
+    finally:
+        for fs in h.live():
+            try:
+                fs.stop()
+            except Exception:   # noqa: BLE001 — teardown boundary
+                pass
+    return report
+
+
+def _fleet_giant(h: _FleetHarness, state: Dict[str, Any]) -> None:
+    """The giant-merge racer, fleet flavor: a chunk-spanning push on
+    doc load0 whose primary the killer crashes mid-merge; the giant
+    survives by retrying (429 AND failover 503/connection loss) until
+    a surviving primary acks it — CRDT idempotence makes the retry
+    safe even if the dead primary had partially merged it."""
+    cfg = h.cfg
+    sid = "fsess-giant"
+    try:
+        fs = h.live()[0]
+        resp, raw = h.request(fs, "POST", "/docs/load0/replicas")
+        rid = json.loads(raw)["replica"]
+        ops, prev = [], 0
+        for i in range(cfg.giant_ops):
+            ts = rid * OFFSET + i + 1
+            ops.append(Add(ts, (prev,), i % 997))
+            prev = ts
+        body = json_codec.dumps(Batch(tuple(ops)))
+        state["primary"] = h.primary_name("load0")
+        state["armed"] = True             # the killer may fire now
+        deadline = time.monotonic() + 600
+        attempt = 0
+        t0 = time.perf_counter()
+        while time.monotonic() < deadline:
+            entry = [s for s in h.live()
+                     if s.name != state.get("primary")] or h.live()
+            fs = entry[attempt % len(entry)]
+            attempt += 1
+            try:
+                resp, raw = h.request(
+                    fs, "POST", "/docs/load0/ops", body=body,
+                    headers={TRACE_HEADER: f"giant-fleet-{attempt:03d}",
+                             SESSION_HEADER: sid}, timeout=600)
+            except (OSError, HTTPException):
+                time.sleep(0.2)
+                continue
+            if resp.status == 429:
+                time.sleep(min(float(
+                    resp.getheader("Retry-After") or 1), 0.1))
+                continue
+            if resp.status == 503:
+                time.sleep(min(float(
+                    resp.getheader("Retry-After") or 1), 0.5))
+                continue
+            out = json.loads(raw)
+            if resp.status == 200 and out.get("accepted"):
+                state["acked_s"] = round(time.perf_counter() - t0, 3)
+                state["served_by"] = out.get("served_by")
+                served = out.get("served_by") or {}
+                h.oracle.observe_write_ack(
+                    sid, f"load0@{served.get('name')}."
+                         f"{served.get('epoch')}", out["trace_id"])
+                return
+            h.errors.append(f"giant -> {resp.status}")
+            return
+        h.errors.append("giant never acked")
+    except Exception as e:          # noqa: BLE001 — harness boundary
+        h.errors.append(f"giant: {e!r}")
+
+
+def _fleet_killer(h: _FleetHarness, giant_state: Dict[str, Any]
+                  ) -> None:
+    """Crash the giant doc's primary mid-merge (after the giant is in
+    flight), wait out failover, then — when configured — restart the
+    server under its old name and record the bumped fencing epoch."""
+    cfg = h.cfg
+    try:
+        deadline = time.monotonic() + 120
+        while not giant_state.get("armed"):
+            if time.monotonic() > deadline:
+                h.errors.append("killer: giant never armed")
+                return
+            time.sleep(0.01)
+        victim = giant_state.get("primary") or h.live()[0].name
+        # let the giant land in the victim's queue / start merging
+        time.sleep(0.3)
+        t_kill = time.monotonic()
+        h.crash(victim)
+        h.kill_report["victim"] = victim
+        # wait until routing actually failed over (lease TTL)
+        while h.primary_name("load0") in (victim, None):
+            if time.monotonic() - t_kill > 60:
+                h.errors.append("failover never happened")
+                return
+            time.sleep(0.05)
+        h.kill_report["failover_s"] = round(
+            time.monotonic() - t_kill, 3)
+        if cfg.restart_killed:
+            # rejoin under the SAME name: crash-safe re-acquisition
+            # bumps the fencing token; anti-entropy refills the state
+            fs = h.spawn(victim)
+            h.kill_report["rejoined_epoch"] = fs.node.epoch()
+            with h.lock:
+                h.dead.remove(victim)
+    except Exception as e:          # noqa: BLE001 — harness boundary
+        h.errors.append(f"killer: {e!r}")
+
+
+def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
+                   load_wall_s: float) -> Dict[str, Any]:
+    cfg = h.cfg
+    # drain every live engine, then wait for anti-entropy convergence
+    # (fingerprint-equal snapshots on every replica, per doc)
+    for fs in h.live():
+        fs.node.engine.flush(timeout=120)
+    docs = sorted({s.doc for s in sessions}
+                  | ({"load0"} if cfg.giant_ops else set()))
+    deadline = time.monotonic() + 120
+    converged: Dict[str, str] = {}
+    while time.monotonic() < deadline:
+        fps: Dict[str, set] = {}
+        ok = True
+        for doc in docs:
+            seen = set()
+            for fs in h.live():
+                try:
+                    resp, _ = h.request(fs, "GET", f"/docs/{doc}")
+                except (OSError, HTTPException):
+                    ok = False
+                    continue
+                if resp.status != 200:
+                    ok = False
+                    continue
+                seen.add(resp.getheader("X-State-Fingerprint"))
+            fps[doc] = seen
+            ok = ok and len(seen) == 1
+        if ok:
+            converged = {d: next(iter(s)) for d, s in fps.items()}
+            break
+        time.sleep(0.1)
+    else:
+        h.errors.append(f"fleet never converged: { {d: sorted(s) for d, s in fps.items()} }")
+    # final reads: every session reads its doc from EVERY replica
+    # (convergence across sessions per replica), and every replica's
+    # state fingerprint feeds the cross-replica convergence check
+    for s in sessions:
+        for fs in h.live():
+            s._read_via(fs, final=True)
+    for doc in docs:
+        for fs in h.live():
+            try:
+                resp, _ = h.request(fs, "GET", f"/docs/{doc}")
+            except (OSError, HTTPException):
+                continue
+            if resp.status == 200:
+                h.oracle.observe_replica_state(
+                    doc, f"{fs.name}.{resp.getheader('X-Replica-Epoch')}",
+                    resp.getheader("X-State-Fingerprint"))
+    # acked-value durability: every value a session ever got acked must
+    # be in the converged state (the sessions re-push through survivors
+    # on primary death, so a kill may delay but never lose them)
+    for doc in docs:
+        fs = h.live()[0]
+        try:
+            resp, raw = h.request(fs, "GET", f"/docs/{doc}")
+            served = set(json.loads(raw).get("values", []))
+        except (OSError, HTTPException):
+            served = set()
+        for s in sessions:
+            if s.doc != doc:
+                continue
+            missing = [v for v in s.surviving_values()
+                       if v not in served]
+            if missing:
+                h.errors.append(
+                    f"{s.sid}: acked values missing after "
+                    f"convergence: {missing[:3]}")
+    # the scrape surface must hold on a fleet member, cluster families
+    # included, under the strict naming contract
+    resp, raw = h.request(h.live()[0], "GET", "/metrics/prom")
+    fams = prom_mod.parse_text(raw.decode())
+    violations = h.oracle.finalize()
+
+    def _pct(sorted_vals, q):
+        return round(sorted_vals[min(len(sorted_vals) - 1,
+                                     (q * len(sorted_vals)) // 100)], 4) \
+            if sorted_vals else None
+
+    lag = sorted(h.lag_s)
+    rp = sorted(h.read_ms_primary)
+    rr = sorted(h.read_ms_replica)
+    errors = [e for s in sessions for e in s.errors] + h.errors
+    per_server = {fs.name: {
+        "ops_merged": sum(d.ops_merged for d in fs.node.engine.docs()),
+        "node_id": fs.node.node_id(), "epoch": fs.node.epoch(),
+        "antientropy": fs.node.antientropy.stats()["rounds"],
+    } for fs in h.live()}
+    leaves = sum(s.leaves_acked for s in sessions) \
+        + (cfg.giant_ops if cfg.giant_ops and "acked_s" in giant_state
+           else 0)
+    ost = h.oracle.stats()
+    return {
+        "harness": "loadgen-fleet",
+        "servers": cfg.n_servers,
+        "sessions": cfg.n_sessions,
+        "docs": cfg.n_docs,
+        "writes_acked": sum(s.writes_acked for s in sessions),
+        "leaves_acked": leaves,
+        "load_wall_s": round(load_wall_s, 3),
+        "ops_per_sec": round(leaves / load_wall_s, 1),
+        "shed_429": sum(s.shed_429 for s in sessions),
+        "retry_409": sum(s.retry_409 for s in sessions),
+        "reads_primary": len(rp),
+        "reads_replica": len(rr),
+        "read_primary_p50_ms": _pct(rp, 50),
+        "read_primary_p99_ms": _pct(rp, 99),
+        "read_replica_p50_ms": _pct(rr, 50),
+        "read_replica_p99_ms": _pct(rr, 99),
+        "lag_probes": len(lag),
+        "lag_censored": h.lag_censored,
+        "lag_p50_s": _pct(lag, 50),
+        "lag_p99_s": _pct(lag, 99),
+        "lag_max_s": round(lag[-1], 4) if lag else None,
+        "giant": giant_state or None,
+        "kill": h.kill_report or None,
+        "converged": converged,
+        "per_server": per_server,
+        "oracle": ost,
+        "violations": violations,
+        "prom_cluster_families": sorted(
+            f for f in fams if f.startswith("crdt_cluster_")),
+        "errors": errors[:12],
+    }
+
+
 def main(argv) -> None:
     cfg = LoadgenConfig()
+    fleet = "--fleet" in argv
+    argv = [a for a in argv if a != "--fleet"]
     if argv:
         cfg.n_sessions = int(argv[0])
     if len(argv) > 1:
         cfg.writes_per_session = int(argv[1])
+    if fleet:
+        cfg.n_servers = max(cfg.n_servers, 3)
+        print(json.dumps(run_fleet(cfg)), flush=True)
+        return
     print(json.dumps(run(cfg)), flush=True)
 
 

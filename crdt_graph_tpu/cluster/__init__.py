@@ -1,0 +1,37 @@
+"""Replica fleet: consistent-hash routing, replica-id leases, and
+background anti-entropy between serving processes (docs/CLUSTER.md).
+
+The reference delegates distribution to "a coordinating server that
+assigns replica ids" plus an ``operationsSince`` anti-entropy contract
+(PAPER.md survey §1); this package is that coordinator made real, as a
+fleet of peers instead of a privileged process:
+
+- :mod:`~crdt_graph_tpu.cluster.kv` — the small coordination
+  key-value store everything else is built on (in-process for tests,
+  file-backed for localhost fleets, adapter-ready for etcd/the jax
+  coordination service in a pod);
+- :mod:`~crdt_graph_tpu.cluster.ring` — consistent-hash doc→server
+  routing over the live membership, with deterministic rebalancing;
+- :mod:`~crdt_graph_tpu.cluster.lease` — TTL replica-id leases with
+  fencing tokens and crash-safe re-acquisition (membership IS the
+  lease table);
+- :mod:`~crdt_graph_tpu.cluster.antientropy` — the background sync
+  daemon: peers exchange packed ``operationsSince`` windows with
+  per-peer high-water marks, delta caps, and backoff + jitter;
+- :mod:`~crdt_graph_tpu.cluster.gateway` — the store the HTTP layer
+  serves: any server accepts any request, writes forward to the doc's
+  primary, reads serve the LOCAL replica snapshot with honest
+  ``X-Replica-*`` / ``X-State-Fingerprint`` headers.
+
+Run one node: ``python -m crdt_graph_tpu.cluster --name n0
+--kv-dir /tmp/fleet --port 8931``.
+"""
+from .antientropy import AntiEntropy
+from .gateway import ClusterNode, FleetServer, ForwardError
+from .kv import FileKV, MemoryKV
+from .lease import Lease, LeaseError, LeaseLost, LeaseService
+from .ring import HashRing
+
+__all__ = ["AntiEntropy", "ClusterNode", "FileKV", "FleetServer",
+           "ForwardError", "HashRing", "Lease", "LeaseError",
+           "LeaseLost", "LeaseService", "MemoryKV"]

@@ -1,0 +1,72 @@
+"""Run one fleet node as a process:
+
+``python -m crdt_graph_tpu.cluster --name n0 --kv-dir /tmp/fleet
+--port 8931 [--ttl 5.0] [--ae-interval 0.25] [--delta-cap 65536]``
+
+All nodes pointed at the same ``--kv-dir`` (a shared FileKV spool —
+one host) discover each other through the lease table and converge
+through anti-entropy; no argument lists the peers.  Prints one
+``READY {json}`` line to stdout once serving (the chaos soak parses
+it), then serves until SIGTERM/SIGINT (graceful: lease released) or a
+hard kill (crash path: peers fail it over on lease expiry).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m crdt_graph_tpu.cluster")
+    ap.add_argument("--name", required=True,
+                    help="stable node name (restart reclaims the "
+                         "same lease slot)")
+    ap.add_argument("--kv-dir", required=True,
+                    help="shared FileKV spool directory")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=5.0)
+    ap.add_argument("--ae-interval", type=float, default=0.25)
+    ap.add_argument("--delta-cap", type=int, default=65_536)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin this node to the host CPU backend "
+                         "(localhost test fleets: scrubs the TPU "
+                         "plugin env exactly like the test workers, "
+                         "so a node never touches the device tunnel)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        # before anything imports jax (the package __init__ is
+        # jax-free; serve/ is not)
+        from ..utils import hostenv
+        hostenv.scrub_tpu_env(1)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+
+    from . import FileKV, FleetServer
+
+    fs = FleetServer(args.name, FileKV(args.kv_dir), port=args.port,
+                     ttl_s=args.ttl, ae_interval_s=args.ae_interval,
+                     delta_cap=args.delta_cap)
+    print("READY " + json.dumps(
+        {"name": fs.name, "addr": fs.addr,
+         "id": fs.node.node_id(), "epoch": fs.node.epoch()}),
+        flush=True)
+
+    done = threading.Event()
+
+    def _term(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    done.wait()
+    fs.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

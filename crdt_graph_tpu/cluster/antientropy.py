@@ -1,0 +1,306 @@
+"""Background anti-entropy: every node pulls every peer's applied-op
+suffix on a short interval, so any write accepted anywhere reaches
+every replica without a client in the loop.
+
+This is the reference's ``operationsSince`` contract
+(CRDTree.elm:390-418) run server-to-server: each (peer, doc) pair keeps
+a **high-water mark** — the timestamp of the last Add served by THAT
+peer — and each round pulls ``GET /docs/{d}/ops?since=<hw>&limit=<cap>``
+off the peer's published snapshot (``engine.packed_since_window``: the
+window is bounded, always ends on an Add so the mark stays a valid
+``since`` terminator, and the ``X-Since-More`` header makes a giant
+catch-up resume immediately instead of waiting a round per window).
+The inclusive-terminator overlap row and any write that raced in twice
+absorb as duplicates — idempotence is the CRDT's, not the daemon's.
+
+Failure shape (docs/CLUSTER.md §Failure matrix):
+
+- **peer down** — per-peer exponential backoff with jitter
+  (``base·2^k``, capped), reset on the first successful round; the
+  daemon never blocks on a dead peer longer than the HTTP timeout;
+- **peer restarted with an empty log** — the peer answers
+  ``X-Since-Found: 0`` for a mark it no longer knows; the puller
+  resets that mark to 0 and re-pulls from scratch (duplicates absorb)
+  instead of spinning on empty batches;
+- **local backpressure** — a pull that sheds on our own admission
+  queue (``QueueFull``) is NOT a peer failure: the round moves on and
+  the next round retries with the same mark.
+
+Pulled deltas enter through the ordinary write path
+(``ServedDoc.apply_body`` → scheduler → published snapshot), so synced
+ops are observable exactly like client writes: commit records, trace
+ids (``ae-<node>-<n>``), and oracle-visible snapshot publishes.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import threading
+import time
+from http.client import HTTPConnection, HTTPException
+from typing import Dict, Optional
+
+from ..obs.trace import (SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
+                         SINCE_NEXT_HEADER)
+from ..serve.metrics import Histogram, LATENCY_BOUNDS_MS
+from ..serve.queue import QueueFull, SchedulerStopped
+
+EMPTY_BATCH = b'{"op":"batch","ops":[]}'
+
+
+class _PeerFailure(Exception):
+    pass
+
+
+class _PeerState:
+    __slots__ = ("addr", "hw", "hw_digest", "pulls", "ops_applied",
+                 "dup_windows_skipped", "failures", "fail_streak",
+                 "backoff_until", "last_ok", "last_err")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.hw: Dict[str, int] = {}     # doc -> last Add ts served
+        # doc -> (since, sha1(body)) of the last window APPLIED from
+        # this peer: `operations_since` serves the terminator row
+        # inclusively, so at steady state every round re-serves a
+        # known-duplicate window — byte-identical to the one already
+        # applied — which must not churn the scheduler forever
+        self.hw_digest: Dict[str, tuple] = {}
+        self.pulls = 0
+        self.ops_applied = 0
+        self.dup_windows_skipped = 0
+        self.failures = 0
+        self.fail_streak = 0
+        self.backoff_until = 0.0
+        self.last_ok: Optional[float] = None   # monotonic
+        self.last_err: Optional[str] = None
+
+
+class AntiEntropy(threading.Thread):
+    """One node's sync daemon.  ``node`` is the
+    :class:`~crdt_graph_tpu.cluster.gateway.ClusterNode` that owns it
+    (membership view + local engine)."""
+
+    def __init__(self, node, interval_s: float = 0.25,
+                 delta_cap: int = 65_536,
+                 backoff_base_s: float = 0.25,
+                 backoff_max_s: float = 10.0,
+                 jitter: float = 0.25,
+                 http_timeout_s: float = 15.0,
+                 max_windows_per_doc: int = 10_000,
+                 seed: Optional[int] = None):
+        super().__init__(name=f"antientropy-{node.name}", daemon=True)
+        self.node = node
+        self.interval_s = interval_s
+        self.delta_cap = delta_cap
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.http_timeout_s = http_timeout_s
+        self.max_windows_per_doc = max_windows_per_doc
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._round_lock = threading.Lock()
+        self._peers: Dict[str, _PeerState] = {}
+        self._lock = threading.Lock()    # guards _peers + counters
+        self.rounds = 0
+        self.round_ms = Histogram(LATENCY_BOUNDS_MS)
+        self._trace_n = 0
+        self.local_shed = 0
+        self.started_at = time.monotonic()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sync_now(respect_backoff=True)
+            except Exception:   # noqa: BLE001 — daemon boundary: a bug
+                pass            # must not kill replication for good
+
+    # -- one round --------------------------------------------------------
+
+    def sync_now(self, respect_backoff: bool = False) -> Dict[str, bool]:
+        """Run ONE full round synchronously in the calling thread (the
+        deterministic entry the tier-1 chaos test drives; the daemon
+        loop calls it too).  Returns per-peer success.  Serialized —
+        a test-driven round and a daemon round never interleave."""
+        with self._round_lock:
+            t0 = time.perf_counter()
+            results: Dict[str, bool] = {}
+            now = time.monotonic()
+            members = self.node.members()
+            for name, lease in sorted(members.items()):
+                if name == self.node.name:
+                    continue
+                st = self._peer_state(name, lease.addr)
+                if respect_backoff and now < st.backoff_until:
+                    continue
+                try:
+                    self._sync_peer(st)
+                except (_PeerFailure, OSError, HTTPException,
+                        ValueError, json.JSONDecodeError) as e:
+                    # HTTPException: the peer died mid-response
+                    # (IncompleteRead et al. are not OSErrors) — a
+                    # PEER failure like any other, not a round-abort
+                    self._peer_failed(st, e)
+                    results[name] = False
+                else:
+                    with self._lock:
+                        st.fail_streak = 0
+                        st.backoff_until = 0.0
+                        st.last_ok = time.monotonic()
+                    results[name] = True
+            with self._lock:
+                self.rounds += 1
+                self.round_ms.observe((time.perf_counter() - t0) * 1e3)
+            return results
+
+    def _peer_state(self, name: str, addr: str) -> _PeerState:
+        with self._lock:
+            st = self._peers.get(name)
+            if st is None:
+                st = self._peers[name] = _PeerState(addr)
+            elif st.addr != addr:
+                # the peer restarted on a new port: its log may be
+                # fresh too — the marks stay (X-Since-Found resets any
+                # that no longer resolve) but the transport must follow
+                st.addr = addr
+            return st
+
+    def _peer_failed(self, st: _PeerState, e: Exception) -> None:
+        with self._lock:
+            st.failures += 1
+            st.fail_streak += 1
+            st.last_err = repr(e)
+            delay = min(self.backoff_max_s,
+                        self.backoff_base_s * 2 ** (st.fail_streak - 1))
+            delay *= 1.0 + self.jitter * self._rng.random()
+            st.backoff_until = time.monotonic() + delay
+
+    # -- the wire ---------------------------------------------------------
+
+    def _sync_peer(self, st: _PeerState) -> None:
+        host, port = st.addr.rsplit(":", 1)
+        conn = HTTPConnection(host, int(port),
+                              timeout=self.http_timeout_s)
+        try:
+            conn.request("GET", "/docs")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise _PeerFailure(f"GET /docs -> {resp.status}")
+            for doc in json.loads(body)["docs"]:
+                self._pull_doc(conn, st, doc)
+        finally:
+            conn.close()
+
+    def _pull_doc(self, conn: HTTPConnection, st: _PeerState,
+                  doc: str) -> None:
+        for _ in range(self.max_windows_per_doc):
+            since = st.hw.get(doc, 0)
+            conn.request("GET", f"/docs/{doc}/ops?since={since}"
+                                f"&limit={self.delta_cap}")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status == 404:
+                return              # raced a just-created doc listing
+            if resp.status != 200:
+                raise _PeerFailure(f"GET /ops -> {resp.status}")
+            with self._lock:
+                st.pulls += 1
+            if resp.getheader(SINCE_FOUND_HEADER) == "0":
+                if since == 0:
+                    return          # peer genuinely has nothing
+                st.hw[doc] = 0      # peer lost our mark: full resync
+                continue
+            if body != EMPTY_BATCH:
+                digest = (since, hashlib.sha1(body).digest())
+                if st.hw_digest.get(doc) == digest:
+                    # byte-identical to the window already applied
+                    # from this mark: the inclusive-terminator overlap
+                    # (plus any trailing-delete tail) at steady state
+                    # — nothing new, skip the write path entirely
+                    with self._lock:
+                        st.dup_windows_skipped += 1
+                else:
+                    applied = self._apply(doc, body)
+                    with self._lock:
+                        st.ops_applied += applied
+                    st.hw_digest[doc] = digest
+            nxt = resp.getheader(SINCE_NEXT_HEADER)
+            if nxt is not None:
+                st.hw[doc] = int(nxt)
+            if resp.getheader(SINCE_MORE_HEADER) != "1":
+                return
+        raise _PeerFailure(f"doc {doc!r}: window chain exceeded "
+                           f"{self.max_windows_per_doc}")
+
+    def _apply(self, doc: str, body: bytes) -> int:
+        from ..core import operation as op_mod
+        self._trace_n += 1
+        tid = f"ae-{self.node.name}-{self._trace_n:08d}"
+        try:
+            accepted, applied = self.node.engine.get(doc).apply_body(
+                body, trace_id=tid)
+        except QueueFull as e:
+            # OUR admission queue is full — local backpressure, not a
+            # peer fault.  Raised BEFORE the mark advances (the caller
+            # reads X-Since-Next after apply), so the next round
+            # re-pulls this same window and nothing is lost.
+            with self._lock:
+                self.local_shed += 1
+            raise _PeerFailure(f"local admission queue full: {e}") \
+                from e
+        except SchedulerStopped as e:
+            raise _PeerFailure(f"local engine stopped: {e}") from e
+        if not accepted:
+            # a window the PEER applied must apply here too (our log
+            # is a superset of the pulled prefix) — a rejection is a
+            # transient local condition, and silently skipping it
+            # while the mark advances would lose the window for good
+            raise _PeerFailure(f"local apply rejected window of "
+                               f"doc {doc!r}")
+        return op_mod.count(applied)
+
+    # -- exposition -------------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Counter/gauge snapshot (``/cluster`` + the
+        ``crdt_cluster_antientropy_*`` prom families)."""
+        now = time.monotonic()
+        with self._lock:
+            peers = {
+                name: {
+                    "addr": st.addr,
+                    "pulls": st.pulls,
+                    "ops_applied": st.ops_applied,
+                    "dup_windows_skipped": st.dup_windows_skipped,
+                    "failures": st.failures,
+                    "fail_streak": st.fail_streak,
+                    "backoff_s": max(0.0, round(
+                        st.backoff_until - now, 3)),
+                    # the LAG signal: seconds since this peer was last
+                    # fully synced (daemon-start-relative until the
+                    # first success)
+                    "sync_age_s": round(
+                        now - (st.last_ok if st.last_ok is not None
+                               else self.started_at), 3),
+                    "docs_tracked": len(st.hw),
+                    "last_err": st.last_err,
+                }
+                for name, st in sorted(self._peers.items())
+            }
+            return {
+                "rounds": self.rounds,
+                "interval_s": self.interval_s,
+                "delta_cap": self.delta_cap,
+                "round_ms": self.round_ms.snapshot(),
+                "round_ms_export": self.round_ms.export(),
+                "local_shed": self.local_shed,
+                "peers": peers,
+            }

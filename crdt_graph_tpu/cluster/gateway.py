@@ -1,0 +1,381 @@
+"""The fleet gateway: every server accepts every request.
+
+:class:`ClusterNode` wraps one :class:`~crdt_graph_tpu.serve.
+ServingEngine` with the fleet surface the HTTP layer (service/http.py)
+dispatches on:
+
+- **Writes route to the primary.**  ``write_route`` resolves the
+  document's owner on the consistent-hash ring over the LIVE lease
+  table; a non-primary node relays the request verbatim
+  (``forward_write``: bounded connection retries with ring re-resolution
+  between attempts, upstream ``429``/``Retry-After`` passed straight
+  through so backpressure keeps one semantic fleet-wide).  A request
+  already carrying ``X-Fleet-Forwarded`` always applies locally — one
+  hop maximum, no forwarding loops, and a write landing on a deposed
+  primary is merely suboptimal, never wrong: the CRDT converges from
+  any application site via anti-entropy (docs/CLUSTER.md §Failure
+  matrix).
+- **Reads are replica-local.**  Read endpoints resolve against this
+  node's own published snapshot — never proxied — and
+  ``extra_read_headers`` stamps the replica identity
+  (``X-Replica-Id``/``-Name``/``-Epoch``) and the replica-independent
+  ``X-State-Fingerprint`` next to the existing ``X-Commit-Seq``/
+  ``X-Snapshot-Fingerprint``, so a client (or the session oracle)
+  can SEE exactly how stale the answering replica is.
+- **Replica ids are fleet-unique.**  ``POST /docs/{id}/replicas`` on
+  any server allocates from the KV counter ``replica/{doc}``
+  (kv.next_counter), so failover never re-issues an id.
+
+:class:`FleetServer` bundles node + HTTP server + lifecycle for the
+in-process fleets the tests, the smoke, and the loadgen fleet mode
+spin up — including ``crash()``, which drops the node the way a
+``kill -9`` would (no lease release, no graceful drain).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from http.client import HTTPConnection, HTTPException
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import flight as flight_mod
+from ..obs import prom as prom_mod
+from ..obs.trace import (FORWARDED_HEADER, REPLICA_EPOCH_HEADER,
+                         REPLICA_HEADER, REPLICA_NAME_HEADER,
+                         SESSION_HEADER, STATE_FP_HEADER, TRACE_HEADER)
+from ..serve import ServingEngine
+from . import kv as kv_mod
+from .antientropy import AntiEntropy
+from .lease import Lease, LeaseKeeper, LeaseService
+from .ring import HashRing
+
+# headers relayed verbatim from a forwarded write's upstream response
+_RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER,
+                  SESSION_HEADER, REPLICA_HEADER, REPLICA_NAME_HEADER,
+                  REPLICA_EPOCH_HEADER)
+
+
+class ForwardError(Exception):
+    """The document's primary could not be reached within the retry
+    budget.  The HTTP layer answers 503 + Retry-After — the client
+    retries once the lease table has failed the primary over (≤ one
+    TTL)."""
+
+    def __init__(self, doc_id: str, detail: str,
+                 retry_after_s: int = 1):
+        super().__init__(f"primary for {doc_id!r} unreachable: "
+                         f"{detail}; retry in ~{retry_after_s}s")
+        self.doc_id = doc_id
+        self.retry_after_s = retry_after_s
+
+
+class ClusterNode:
+    """One fleet member: engine + lease + ring view + anti-entropy.
+    DocumentStore-compatible (it IS the ``store`` behind
+    ``service.http.make_server``)."""
+
+    def __init__(self, name: str, kv, engine: Optional[ServingEngine]
+                 = None, *, ttl_s: float = 5.0, max_ids: int = 64,
+                 ring_ttl_s: float = 0.25,
+                 ae_interval_s: float = 0.25,
+                 delta_cap: int = 65_536,
+                 forward_retries: int = 4,
+                 forward_timeout_s: float = 30.0,
+                 vnodes: int = 64,
+                 clock=time.time):
+        self.name = name
+        self.kv = kv
+        # each node owns its OWN flight recorder: in-process fleets
+        # must not interleave three servers' commit records in one
+        # process-wide ring (the oracle tags records per node)
+        self.engine = engine if engine is not None else ServingEngine(
+            flight=flight_mod.FlightRecorder())
+        self.leases = LeaseService(kv, ttl_s=ttl_s, max_ids=max_ids,
+                                   clock=clock)
+        self.lease: Optional[Lease] = None
+        self.keeper: Optional[LeaseKeeper] = None
+        self.antientropy = AntiEntropy(self, interval_s=ae_interval_s,
+                                       delta_cap=delta_cap)
+        self.forward_retries = forward_retries
+        self.forward_timeout_s = forward_timeout_s
+        self.vnodes = vnodes
+        self._ring_ttl_s = ring_ttl_s
+        self._ring_lock = threading.Lock()
+        self._ring: Optional[HashRing] = None
+        self._ring_at = 0.0
+        self._counter_lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "forwarded_ok": 0, "forwarded_err": 0,
+            "forward_retries": 0, "forwarded_in": 0,
+            "replica_ids_assigned": 0,
+        }
+        self.started_at = time.monotonic()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, advertise_addr: str) -> "ClusterNode":
+        """Join the fleet: claim a replica-id lease under our stable
+        name (crash-safe: a restart reclaims the old slot with a
+        bumped fencing token) and start renewal + anti-entropy."""
+        self.lease = self.leases.acquire(self.name, advertise_addr)
+        self.keeper = LeaseKeeper(self.leases, self.lease,
+                                  on_change=self._lease_changed)
+        self.keeper.start()
+        self.antientropy.start()
+        self.refresh_ring()
+        return self
+
+    def _lease_changed(self, lease: Lease) -> None:
+        self.lease = lease
+        self.refresh_ring()
+
+    def close(self, graceful: bool = True, timeout: float = 10.0
+              ) -> None:
+        """``graceful=False`` models a crash: no lease release (the
+        slot ages out over the TTL or is force-expired), no drain —
+        exactly what a killed process leaves behind."""
+        self.antientropy.stop()
+        if self.keeper is not None:
+            self.keeper.stop()
+        if graceful and self.lease is not None:
+            try:
+                self.leases.release(self.lease)
+            except Exception:   # noqa: BLE001 — shutdown boundary
+                pass
+        self.engine.close(timeout=timeout)
+
+    # -- membership / routing ---------------------------------------------
+
+    def members(self) -> Dict[str, Lease]:
+        return self.leases.members()
+
+    def epoch(self) -> int:
+        return self.lease.token if self.lease is not None else 0
+
+    def node_id(self) -> int:
+        return self.lease.id if self.lease is not None else -1
+
+    def refresh_ring(self) -> HashRing:
+        with self._ring_lock:
+            members = {name: lease.addr
+                       for name, lease in self.members().items()}
+            self._ring = HashRing(members, vnodes=self.vnodes)
+            self._ring_at = time.monotonic()
+            return self._ring
+
+    def ring(self) -> HashRing:
+        with self._ring_lock:
+            ring, age = self._ring, time.monotonic() - self._ring_at
+        if ring is None or age > self._ring_ttl_s:
+            return self.refresh_ring()
+        return ring
+
+    def primary_for(self, doc_id: str) -> Optional[str]:
+        return self.ring().primary(doc_id)
+
+    def write_route(self, doc_id: str) -> Optional[str]:
+        """Address to forward a client write to, or None when THIS
+        node should apply it (we are primary, we are the only member,
+        or we are not in the ring at all — then local apply +
+        anti-entropy is strictly better than guessing)."""
+        ring = self.ring()
+        primary = ring.primary(doc_id)
+        if primary is None or primary == self.name:
+            return None
+        return ring.address(primary)
+
+    # -- write forwarding --------------------------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def forward_write(self, doc_id: str, body: bytes,
+                      headers: Dict[str, str]
+                      ) -> Optional[Tuple[int, bytes, Dict[str, str]]]:
+        """Relay one client write to the document's primary.  Returns
+        ``(status, body, headers)`` to answer with, or None when the
+        caller should apply locally (we are/became the primary).
+        Raises :class:`ForwardError` after the retry budget."""
+        detail = "no attempt"
+        for attempt in range(self.forward_retries):
+            if attempt:
+                self._count("forward_retries")
+                time.sleep(min(0.25, 0.05 * (2 ** (attempt - 1))))
+                self.refresh_ring()
+            addr = self.write_route(doc_id)
+            if addr is None:
+                return None
+            host, port = addr.rsplit(":", 1)
+            conn = HTTPConnection(host, int(port),
+                                  timeout=self.forward_timeout_s)
+            try:
+                fwd = {"Content-Type": "application/json",
+                       FORWARDED_HEADER: f"{self.name}.{self.epoch()}"}
+                for h in (TRACE_HEADER, SESSION_HEADER):
+                    v = headers.get(h)
+                    if v:
+                        fwd[h] = v
+                conn.request("POST", f"/docs/{doc_id}/ops", body=body,
+                             headers=fwd)
+                resp = conn.getresponse()
+                out_body = resp.read()
+                out_headers = {h: resp.getheader(h)
+                               for h in _RELAY_HEADERS
+                               if resp.getheader(h)}
+                # 429 passes straight through (Retry-After intact):
+                # the PRIMARY's admission queue is the fleet's
+                # backpressure signal, not something to absorb here
+                self._count("forwarded_ok")
+                return resp.status, out_body, out_headers
+            except (OSError, HTTPException) as e:
+                # HTTPException covers a primary dying MID-response
+                # (IncompleteRead/BadStatusLine are not OSErrors) —
+                # exactly what a chaos kill produces; it must burn a
+                # retry, not escape the loop
+                detail = repr(e)
+            finally:
+                conn.close()
+        self._count("forwarded_err")
+        raise ForwardError(doc_id, detail)
+
+    # -- fleet identity on the wire ---------------------------------------
+
+    def extra_read_headers(self, snap) -> Dict[str, str]:
+        return {
+            REPLICA_HEADER: str(self.node_id()),
+            REPLICA_NAME_HEADER: self.name,
+            REPLICA_EPOCH_HEADER: str(self.epoch()),
+            STATE_FP_HEADER: snap.state_fingerprint(),
+        }
+
+    def served_by(self) -> Dict[str, object]:
+        """Write-response attribution (the committing node)."""
+        return {"id": self.node_id(), "name": self.name,
+                "epoch": self.epoch()}
+
+    def assign_replica(self, doc_id: str) -> int:
+        """Fleet-unique CLIENT replica id from the KV counter."""
+        rid = kv_mod.next_counter(self.kv, f"replica/{doc_id}")
+        self._count("replica_ids_assigned")
+        return rid
+
+    def note_forwarded_in(self) -> None:
+        self._count("forwarded_in")
+
+    # -- store surface (service/http.py duck type) ------------------------
+
+    def get(self, doc_id: str, create: bool = True):
+        return self.engine.get(doc_id, create=create)
+
+    def ids(self) -> List[str]:
+        return self.engine.ids()
+
+    def docs(self):
+        return self.engine.docs()
+
+    @staticmethod
+    def encode_ops(op) -> str:
+        return ServingEngine.encode_ops(op)
+
+    @staticmethod
+    def decode_ops(payload):
+        return ServingEngine.decode_ops(payload)
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        return self.engine.flush(timeout=timeout)
+
+    @property
+    def scheduler(self):
+        return self.engine.scheduler
+
+    @property
+    def flight(self):
+        return self.engine.flight
+
+    def cluster_stats(self) -> Dict:
+        with self._counter_lock:
+            counters = dict(self.counters)
+        members = self.members()
+        local_docs = self.ids()
+        ring = self.ring()
+        return {
+            "node": {"name": self.name, "id": self.node_id(),
+                     "epoch": self.epoch(),
+                     "addr": self.lease.addr if self.lease else None,
+                     "lease_remaining_s": round(
+                         self.lease.expires - self.leases.clock(), 3)
+                     if self.lease else None,
+                     "lease_losses": self.keeper.losses
+                     if self.keeper else 0,
+                     "lease_reacquired": self.keeper.reacquired
+                     if self.keeper else 0},
+            "members": {name: {"id": ls.id, "addr": ls.addr,
+                               "epoch": ls.token}
+                        for name, ls in sorted(members.items())},
+            "ring": {"vnodes": self.vnodes,
+                     "spread": ring.spread(local_docs)},
+            "primaries": {d: ring.primary(d) for d in local_docs},
+            "counters": counters,
+            "antientropy": self.antientropy.stats(),
+        }
+
+    def cluster_view(self) -> Dict:
+        """``GET /cluster``."""
+        return self.cluster_stats()
+
+    def scheduler_metrics(self) -> Dict:
+        out = self.engine.scheduler_metrics()
+        out["cluster"] = self.cluster_stats()
+        return out
+
+    def render_prom(self) -> str:
+        return prom_mod.render_engine(self.engine) \
+            + prom_mod.render_cluster(self)
+
+    def debug_flight(self) -> Dict:
+        return self.engine.debug_flight()
+
+
+class FleetServer:
+    """One in-process fleet member: node + real HTTP server on its own
+    localhost port.  The unit the smoke (--fleet), the loadgen fleet
+    mode, and the tier-1 chaos test compose."""
+
+    def __init__(self, name: str, kv, port: int = 0,
+                 engine: Optional[ServingEngine] = None,
+                 **node_kw):
+        from ..service import make_server
+        self.node = ClusterNode(name, kv, engine=engine, **node_kw)
+        self.server = make_server(port=port, store=self.node)
+        self.port = self.server.server_port
+        self.addr = f"127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever,
+            name=f"fleet-http-{name}", daemon=True)
+        self._thread.start()
+        self.node.start(self.addr)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def stop(self) -> None:
+        """Graceful leave: release the lease so the membership change
+        is immediate."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.node.close(graceful=True)
+
+    def crash(self) -> None:
+        """Model ``kill -9`` as closely as one process can: stop
+        listening, fail every queued ticket immediately (timeout 0 —
+        no drain, so an unpublished merge's acks die as 503s) and do
+        NOT release the lease — peers discover the death by lease
+        expiry (or an operator ``expire_now``), exactly like a real
+        dead process.  The genuinely preemptive kill (a merge dying
+        mid-kernel) is the process-level chaos test's job
+        (tests/_fleet_worker.py + SIGKILL)."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.node.close(graceful=False, timeout=0.0)

@@ -1323,6 +1323,84 @@ def packed_since_bytes(p: PackedOps, initial_timestamp: int) -> bytes:
         packed_mod.unpack_rows(p, start, n))).encode()
 
 
+def packed_since_window(p: PackedOps, initial_timestamp: int,
+                        limit: int = 0):
+    """Bounded, resumable anti-entropy window over the packed log
+    (``GET /ops?since=&limit=`` — cluster/antientropy.py).
+
+    Returns ``(wire_bytes, meta)`` where ``meta`` is ``{"found",
+    "more", "next_since", "count"}``:
+
+    - ``found`` — whether the ``since`` terminator exists in this log.
+      False means the serving replica does not know the Add the puller
+      resumed from (e.g. it restarted with a fresh log); the puller
+      must reset its high-water mark to 0 and re-pull (duplicates
+      absorb), instead of spinning on empty batches forever.
+    - ``more`` — rows remain past this window; the puller should
+      resume immediately from ``next_since`` rather than waiting for
+      its next round.
+    - ``next_since`` — the timestamp of the last Add served (the
+      resume point: ``operations_since`` terminators are Adds, so a
+      window is trimmed — or, for a pathological all-delete stretch
+      longer than ``limit``, extended — to END on an Add whenever rows
+      remain).  None when the window served no Add (then the puller's
+      existing mark still stands).
+    - ``count`` — rows served.
+
+    ``limit`` ≤ 0 serves the unbounded suffix (wire-compatible with
+    :func:`packed_since_bytes`).  Every window is a plain wire batch —
+    the reference codec never sees the windowing, which lives entirely
+    in the HTTP headers (service/http.py)."""
+    empty = b'{"op":"batch","ops":[]}'
+    n = p.num_ops
+    if initial_timestamp == 0:
+        start = 0
+    else:
+        start = p.index().get(initial_timestamp)
+        if start is None or start >= n:
+            return empty, {"found": False, "more": False,
+                           "next_since": None, "count": 0}
+    if start >= n:
+        return empty, {"found": True, "more": False,
+                       "next_since": None, "count": 0}
+    stop = n
+    if 0 < limit < n - start:
+        kinds = p.kind
+        window_adds = np.nonzero(
+            kinds[start:start + limit] == packed_mod.KIND_ADD)[0]
+        if len(window_adds):
+            # trim so the window ends on its last Add — the resume
+            # terminator; the trailing deletes re-serve next window
+            stop = start + int(window_adds[-1]) + 1
+        else:
+            # all-delete window: extend through the next Add so the
+            # puller still gets a resume point (deletes cannot be
+            # ``since`` terminators)
+            later = np.nonzero(
+                kinds[start + limit:n] == packed_mod.KIND_ADD)[0]
+            stop = start + limit + int(later[0]) + 1 if len(later) \
+                else n
+        if stop < n and not np.any(
+                kinds[stop:n] == packed_mod.KIND_ADD):
+            # everything past the trimmed window is deletes: serve the
+            # tail NOW (there is no later Add to carry it, so "re-serve
+            # next window" would chain forever on the same terminator
+            # and the final deletes would never replicate)
+            stop = n
+    if stop >= n:
+        body = packed_since_bytes(p, initial_timestamp)
+        stop = n
+    else:
+        sub = packed_mod.select_rows(p, np.arange(start, stop))
+        body = packed_since_bytes(sub, 0)
+    served_adds = np.nonzero(
+        p.kind[start:stop] == packed_mod.KIND_ADD)[0]
+    next_since = int(p.ts[start + int(served_adds[-1])]) \
+        if len(served_adds) else None
+    return body, {"found": True, "more": stop < n,
+                  "next_since": next_since, "count": stop - start}
+
+
 def write_packed_npz(path, p: PackedOps, meta: dict,
                      compress: bool = True) -> None:
     """Write the packed-checkpoint npz wire/disk format: ``p``'s real

@@ -175,6 +175,12 @@ class SessionOracle:
         self._fp_by_seq: Dict[Tuple[str, int], str] = {}
         # final quiescent reads: doc_id -> {session: (seq, fp)}
         self._final: Dict[str, Dict[str, Tuple[int, Optional[str]]]] = {}
+        # fleet convergence evidence (ISSUE 7): doc_id -> {replica:
+        # state_fingerprint} — the replica-INDEPENDENT fingerprints
+        # (serve/snapshot.py state_fingerprint, X-State-Fingerprint)
+        # each server's quiescent snapshot reported; finalize()
+        # checks every replica of a document agrees
+        self._replica_states: Dict[str, Dict[str, str]] = {}
         self.checks: Dict[str, int] = {k: 0 for k in CHECKS}
         self.violation_counts: Dict[str, int] = {k: 0 for k in CHECKS}
         self.violations: List[Dict[str, Any]] = []
@@ -331,6 +337,19 @@ class SessionOracle:
             self._final.setdefault(doc_id, {})[session] = (
                 seq, fingerprint)
 
+    def observe_replica_state(self, doc_id: str, replica: str,
+                              state_fp: str) -> None:
+        """One fleet replica's quiescent state fingerprint for a
+        document (the ``X-State-Fingerprint`` of its final read —
+        replica-independent by construction, so every server of a
+        converged fleet reports the SAME value).  Feeds the
+        cross-replica convergence check in :meth:`finalize` — the
+        check the single-server oracle always had, finally biting on
+        more than one server."""
+        with self._lock:
+            self._replica_states.setdefault(doc_id, {})[replica] = \
+                state_fp
+
     def ingest_commit_record(self, rec: Dict[str, Any]) -> None:
         """One flight ``CommitRecord`` (as a JSON dict — from the
         recorder's listener hook or a ``/debug/flight`` scrape).
@@ -443,6 +462,17 @@ class SessionOracle:
                             observed=sorted(
                                 (s, v[0], v[1])
                                 for s, v in by_sess.items())[:16])
+                # fleet convergence: every replica's quiescent state
+                # fingerprint of a document must agree (the
+                # fingerprints are replica-independent, so any
+                # disagreement is real divergence, not a seq skew)
+                for doc_id, by_rep in sorted(
+                        self._replica_states.items()):
+                    self.checks[CHECK_CONV] += 1
+                    if len(set(by_rep.values())) > 1:
+                        self._violate(
+                            CHECK_CONV, "-", doc_id,
+                            replicas=sorted(by_rep.items())[:16])
             return list(self.violations)
         finally:
             self._exit()

@@ -245,6 +245,82 @@ def render_engine(engine) -> str:
     return w.render()
 
 
+def render_cluster(node) -> str:
+    """The ``crdt_cluster_*`` families for one fleet node
+    (cluster/gateway.py appends this to :func:`render_engine`'s text —
+    same naming contract, same strict parser).  Anti-entropy **lag** is
+    first-class: ``crdt_cluster_antientropy_sync_age_seconds{peer=}``
+    is how long ago each peer was last fully pulled — the replication
+    staleness an operator alerts on — next to per-peer pull/failure
+    counters, the backoff gauge, and the round-latency histogram."""
+    cs = node.cluster_stats()
+    w = _Writer()
+    me = cs["node"]
+    w.gauge("crdt_cluster_node_id",
+            "This node's leased numeric replica id",
+            me["id"], {"node": me["name"]})
+    w.gauge("crdt_cluster_lease_epoch",
+            "Fencing token of the current lease",
+            me["epoch"], {"node": me["name"]})
+    if me["lease_remaining_s"] is not None:
+        w.gauge("crdt_cluster_lease_remaining_seconds",
+                "Time until this node's lease expires unrenewed",
+                max(0.0, me["lease_remaining_s"]))
+    w.counter("crdt_cluster_lease_losses_total",
+              "Times this node's lease was fenced or lost",
+              me["lease_losses"])
+    w.counter("crdt_cluster_lease_reacquired_total",
+              "Times this node re-acquired after a lost lease",
+              me["lease_reacquired"])
+    w.gauge("crdt_cluster_members", "Live members in the lease table",
+            len(cs["members"]))
+    w.gauge("crdt_cluster_primary_docs",
+            "Local documents whose ring primary is this node",
+            sum(1 for p in cs["primaries"].values()
+                if p == me["name"]))
+    for key, help_text in (
+            ("forwarded_ok", "Client writes relayed to a primary"),
+            ("forwarded_err",
+             "Write forwards that exhausted the retry budget"),
+            ("forward_retries", "Forward connection retries"),
+            ("forwarded_in",
+             "Writes received already forwarded by a peer"),
+            ("replica_ids_assigned",
+             "Fleet-unique client replica ids allocated")):
+        w.counter(f"crdt_cluster_{key}_total", help_text,
+                  cs["counters"].get(key, 0))
+    ae = cs["antientropy"]
+    w.counter("crdt_cluster_antientropy_rounds_total",
+              "Anti-entropy rounds completed", ae["rounds"])
+    w.counter("crdt_cluster_antientropy_local_shed_total",
+              "Pulls shed on the local admission queue",
+              ae["local_shed"])
+    h = ae["round_ms_export"]
+    w.histogram("crdt_cluster_antientropy_round_ms",
+                "Anti-entropy round latency", h["bounds"], h["counts"],
+                h["count"], h["sum"])
+    peer_families = (
+        ("crdt_cluster_antientropy_pulls_total", "counter",
+         "Windows pulled from the peer", "pulls"),
+        ("crdt_cluster_antientropy_ops_applied_total", "counter",
+         "Leaves applied from the peer (duplicates excluded)",
+         "ops_applied"),
+        ("crdt_cluster_antientropy_failures_total", "counter",
+         "Failed sync attempts against the peer", "failures"),
+        ("crdt_cluster_antientropy_sync_age_seconds", "gauge",
+         "Seconds since the peer was last fully synced (the lag)",
+         "sync_age_s"),
+        ("crdt_cluster_antientropy_backoff_seconds", "gauge",
+         "Remaining backoff before the peer is retried", "backoff_s"),
+    )
+    for fname, ftype, help_text, _ in peer_families:
+        w.family(fname, ftype, help_text)
+    for peer, st in ae["peers"].items():
+        for fname, _, _, key in peer_families:
+            w.sample(fname, fname, st[key], {"peer": peer})
+    return w.render()
+
+
 class PromParseError(ValueError):
     """The exposition violated the format or the naming contract."""
 

@@ -40,6 +40,21 @@ SESSION_HEADER = "X-Session-Id"
 SNAP_FP_HEADER = "X-Snapshot-Fingerprint"
 COMMIT_SEQ_HEADER = "X-Commit-Seq"
 
+# fleet identity + sync-window headers (cluster/, docs/CLUSTER.md):
+# every read served by a fleet node names the replica that answered
+# (numeric leased id, stable node name, fencing-token epoch) plus the
+# replica-independent state fingerprint, so staleness and convergence
+# are wire-observable; the since-window headers make `/ops?since=`
+# pulls bounded and resumable without touching the body format
+REPLICA_HEADER = "X-Replica-Id"
+REPLICA_NAME_HEADER = "X-Replica-Name"
+REPLICA_EPOCH_HEADER = "X-Replica-Epoch"
+STATE_FP_HEADER = "X-State-Fingerprint"
+SINCE_NEXT_HEADER = "X-Since-Next"
+SINCE_MORE_HEADER = "X-Since-More"
+SINCE_FOUND_HEADER = "X-Since-Found"
+FORWARDED_HEADER = "X-Fleet-Forwarded"
+
 # accepted client-supplied ids: 8-64 url-safe chars (anything else is
 # re-minted — the id lands in filenames and label values)
 _TRACE_RE = re.compile(r"^[A-Za-z0-9_.-]{8,64}$")

@@ -138,6 +138,11 @@ class ServedDoc:
     def dumps_since_bytes(self, ts: int) -> bytes:
         return self._snap.ops_since_bytes(ts)
 
+    def ops_since_window(self, ts: int, limit: int = 0):
+        """Windowed anti-entropy pull (``GET /ops?since=&limit=``) off
+        the published snapshot — cluster/antientropy.py's wire."""
+        return self._snap.ops_since_window(ts, limit)
+
     def snapshot_packed(self) -> bytes:
         return self._snap.checkpoint_bytes()
 

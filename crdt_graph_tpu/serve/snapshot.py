@@ -35,7 +35,7 @@ class DocSnapshot:
 
     __slots__ = ("doc_id", "seq", "packed", "values", "clock", "replica",
                  "timestamp", "cursor", "max_depth", "log_length",
-                 "log_segments", "committed_at", "_fp")
+                 "log_segments", "committed_at", "_fp", "_sfp")
 
     def __init__(self, doc_id: str, seq: int, packed: packed_mod.PackedOps,
                  values: Tuple[Any, ...], clock: Dict[int, int],
@@ -54,6 +54,7 @@ class DocSnapshot:
         self.log_segments = log_segments
         self.committed_at = time.time()
         self._fp: Optional[str] = None
+        self._sfp: Optional[str] = None
 
     # -- read endpoints ---------------------------------------------------
 
@@ -81,6 +82,35 @@ class DocSnapshot:
                            sorted(self.clock.items()))).encode())
             self._fp = h.hexdigest()[:16]
         return self._fp
+
+    def state_fingerprint(self) -> str:
+        """Replica-INDEPENDENT content fingerprint (``X-State-
+        Fingerprint``, cluster/gateway.py).  :meth:`fingerprint`
+        identifies one server's published generation (it hashes the
+        local ``seq``, which counts that server's commits), so two
+        fleet replicas of the same document never agree on it even
+        when fully converged.  This one hashes only what the CRDT
+        itself determines — the vector clock, the applied-op count
+        (duplicates absorb before the log, so equal op sets give equal
+        counts), and the materialized visible sequence — so converged
+        replicas agree on it regardless of how many commits each took
+        to get there.  The fleet convergence oracle and the chaos
+        tests compare THIS across servers.  Cached; the O(visible)
+        hash is paid at most once per published snapshot."""
+        if self._sfp is None:
+            import hashlib
+            h = hashlib.sha1()
+            h.update(repr((self.doc_id, sorted(self.clock.items()),
+                           self.log_length, self.values)).encode())
+            self._sfp = h.hexdigest()[:16]
+        return self._sfp
+
+    def ops_since_window(self, since: int, limit: int = 0):
+        """Bounded resumable anti-entropy window
+        (``engine.packed_since_window`` over the snapshot's immutable
+        columns): ``(wire_bytes, {"found", "more", "next_since",
+        "count"})``."""
+        return engine_mod.packed_since_window(self.packed, since, limit)
 
     def ops_since_bytes(self, since: int) -> bytes:
         """Wire JSON for ``GET /ops?since=`` straight off the snapshot's

@@ -76,9 +76,12 @@ from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
 from ..obs import prom as prom_mod
-from ..obs.trace import (COMMIT_SEQ_HEADER, SESSION_HEADER,
+from ..obs.trace import (COMMIT_SEQ_HEADER, FORWARDED_HEADER,
+                         SESSION_HEADER, SINCE_FOUND_HEADER,
+                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER,
                          SNAP_FP_HEADER, TRACE_HEADER, ensure_session_id,
                          ensure_trace_id, is_valid_id)
+from ..cluster.gateway import ForwardError
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
                      SchedulerStopped, ServingEngine)
 from .store import DocumentStore
@@ -140,13 +143,19 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
         def _read_trace_headers(self, snap) -> dict:
             """Read-path correlation headers (obs/trace.py): the served
             snapshot's identity plus the session id (adopted from a
-            well-formed ``X-Session-Id``, minted otherwise)."""
-            return {
+            well-formed ``X-Session-Id``, minted otherwise).  A fleet
+            store (cluster/gateway.py) additionally stamps the replica
+            identity + replica-independent state fingerprint, so a
+            replica-local read's staleness is wire-observable."""
+            out = {
                 SNAP_FP_HEADER: snap.fingerprint(),
                 COMMIT_SEQ_HEADER: str(snap.seq),
                 SESSION_HEADER: ensure_session_id(
                     self.headers.get(SESSION_HEADER)),
             }
+            if hasattr(store, "extra_read_headers"):
+                out.update(store.extra_read_headers(snap))
+            return out
 
         def do_GET(self):
             doc_id, sub, query = self._route()
@@ -171,6 +180,11 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     self._send(200, store.debug_flight())
                 elif sub == "/docs":
                     self._send(200, {"docs": store.ids()})
+                elif sub == "/cluster" and \
+                        hasattr(store, "cluster_view"):
+                    # fleet introspection: membership, lease, ring
+                    # spread, anti-entropy state (docs/CLUSTER.md)
+                    self._send(200, store.cluster_view())
                 else:
                     self._send(404, {"error": "not found"})
                 return
@@ -191,12 +205,28 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             elif sub == "/ops":
                 try:
                     since = int(query.get("since", ["0"])[0])
+                    limit = int(query.get("limit", ["0"])[0])
                 except ValueError:
-                    self._send(400, {"error": "since must be an integer"})
+                    self._send(400, {"error": "since and limit must "
+                                              "be integers"})
                     return
                 # pre-encoded fast path: the bootstrap contract serves
-                # the full log, so avoid a json.loads/dumps round trip
-                self._send_raw(200, doc.dumps_since_bytes(since))
+                # the full log, so avoid a json.loads/dumps round trip.
+                # With ?limit= (anti-entropy pulls) the window is
+                # bounded + resumable and its state rides the
+                # X-Since-* headers — the body stays a plain wire
+                # batch either way (engine.packed_since_window)
+                if limit > 0 and hasattr(doc, "ops_since_window"):
+                    body, meta = doc.ops_since_window(since, limit)
+                    self._send_raw(200, body, headers={
+                        SINCE_FOUND_HEADER:
+                            "1" if meta["found"] else "0",
+                        SINCE_MORE_HEADER: "1" if meta["more"] else "0",
+                        **({SINCE_NEXT_HEADER: str(meta["next_since"])}
+                           if meta["next_since"] is not None else {}),
+                    })
+                else:
+                    self._send_raw(200, doc.dumps_since_bytes(since))
             elif sub == "/snapshot":
                 if hasattr(doc, "read_view"):
                     snap = doc.read_view()
@@ -235,9 +265,46 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 self._send(404, {"error": "not found"})
                 return
             if sub == "/replicas":
-                self._send(200,
-                           {"replica": store.get(doc_id).assign_replica()})
+                # a fleet store allocates from the shared KV counter so
+                # ids stay unique across servers AND across primary
+                # failover; the single-server path keeps the local
+                # per-document counter
+                if hasattr(store, "assign_replica"):
+                    store.get(doc_id)      # materialize the local doc
+                    rid = store.assign_replica(doc_id)
+                else:
+                    rid = store.get(doc_id).assign_replica()
+                self._send(200, {"replica": rid})
                 return
+            # fleet write routing (cluster/gateway.py): a non-primary
+            # node relays the request to the document's primary and
+            # answers with the PRIMARY's response verbatim (status,
+            # trace echo, Retry-After backpressure included); a request
+            # already forwarded once always applies locally — one hop,
+            # no loops
+            if hasattr(store, "write_route") \
+                    and self.headers.get(FORWARDED_HEADER) is None:
+                try:
+                    fwd = store.forward_write(
+                        doc_id, body,
+                        {TRACE_HEADER: self.headers.get(TRACE_HEADER),
+                         SESSION_HEADER:
+                             self.headers.get(SESSION_HEADER)})
+                except ForwardError as e:
+                    self._send(503, {"error": str(e)},
+                               headers={"Retry-After":
+                                        str(e.retry_after_s)})
+                    return
+                if fwd is not None:
+                    status, out_body, out_headers = fwd
+                    ctype = out_headers.pop("Content-Type",
+                                            "application/json")
+                    self._send_raw(status, out_body, ctype=ctype,
+                                   headers=out_headers)
+                    return
+            elif self.headers.get(FORWARDED_HEADER) is not None \
+                    and hasattr(store, "note_forwarded_in"):
+                store.note_forwarded_in()
             # trace admission point (obs/trace.py): mint — or adopt a
             # well-formed client-supplied X-Trace-Id — BEFORE parsing,
             # so even a malformed or shed request is attributable; the
@@ -285,6 +352,10 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             n_applied = op_mod.count(applied)
             payload = {"accepted": accepted, "applied_count": n_applied,
                        "trace_id": trace_id}
+            if hasattr(store, "served_by"):
+                # fleet attribution: the node that committed this
+                # write (the oracle keys read-your-writes on it)
+                payload["served_by"] = store.served_by()
             # echo the applied ops only for interactive-scale deltas —
             # for a bootstrap-size push, re-encoding the whole batch
             # into the response costs multiples of the merge itself
@@ -309,6 +380,18 @@ class ServingHTTPServer(ThreadingHTTPServer):
         super().server_close()
         if self.owned_engine is not None:
             self.owned_engine.close()
+
+    def handle_error(self, request, client_address):
+        """A client that hung up mid-response is routine operation —
+        long-poll writers time out, fleet peers crash (chaos tests
+        kill them on purpose) — not a stack trace on stderr.  Anything
+        that is NOT a connection death still gets the default dump."""
+        import sys as _sys
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
 
 
 def make_server(port: int = 0, store=None,

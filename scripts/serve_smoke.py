@@ -18,6 +18,16 @@ after the ``ServingEngine.flush`` barrier), and the server (plus its
 scheduler thread) must shut down cleanly.
 
 Run ad hoc: ``python scripts/serve_smoke.py [docs] [writers] [deltas]``
+
+``--fleet N`` runs the FLEET smoke instead (ISSUE 7): N in-process
+fleet servers (cluster/gateway.py ``FleetServer``) over one shared
+MemoryKV, one write entering through EACH server (forwarded to the
+document's ring primary), then — after anti-entropy — read-your-writes
+verified through a *different* server than the one that took the
+write, with the replica-identity headers (``X-Replica-Id``/``-Name``/
+``-Epoch``, ``X-State-Fingerprint``) and the ``crdt_cluster_*`` prom
+families checked on every member.  Wired into tier-1 via
+tests/test_serve_smoke.py::test_fleet_smoke_end_to_end.
 """
 import json
 import os
@@ -237,8 +247,119 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     return summary
 
 
+def run_fleet(n_servers: int = 3, n_docs: int = 2) -> dict:
+    """The fleet smoke: one write per server, read-your-writes through
+    a DIFFERENT server after anti-entropy, honest replica headers and
+    the cluster scrape surface on every member, clean shutdown."""
+    from http.client import HTTPConnection
+
+    from crdt_graph_tpu.cluster import FleetServer, MemoryKV
+    from crdt_graph_tpu.codec import json_codec
+    from crdt_graph_tpu.core.operation import Add, Batch
+    from crdt_graph_tpu.obs import prom as prom_mod
+
+    assert n_servers >= 2, "a fleet needs at least two servers"
+    kv = MemoryKV()
+    fleet = [FleetServer(f"n{i}", kv, ttl_s=600.0,
+                         ae_interval_s=3600.0)
+             for i in range(n_servers)]
+    # membership settled before traffic: every node joined above, so
+    # one explicit refresh gives every ring the full fleet
+    for fs in fleet:
+        assert len(fs.node.refresh_ring()) == n_servers
+
+    def req(fs, method, path, body=None, headers=None):
+        conn = HTTPConnection("127.0.0.1", fs.port, timeout=60)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            return resp.status, resp.read(), dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    summary = {"servers": n_servers, "docs": n_docs, "writes": 0,
+               "forwarded": 0, "cross_server_ryw": 0}
+    try:
+        doc_ids = [f"fleet{i}" for i in range(n_docs)]
+        own = {}       # (doc, writer server) -> values it got acked
+        for doc in doc_ids:
+            for i, fs in enumerate(fleet):
+                # one write per server on each doc, each through ITS
+                # entry — non-primaries forward to the ring primary
+                st, raw, _ = req(fs, "POST", f"/docs/{doc}/replicas")
+                assert st == 200, (doc, fs.name, raw)
+                rid = json.loads(raw)["replica"]
+                ops, prev = [], 0
+                vals = []
+                for c in range(1, 6):
+                    t = rid * 2**32 + c
+                    vals.append(f"{doc}@{fs.name}:{c}")
+                    ops.append(Add(t, (prev,), vals[-1]))
+                    prev = t
+                st, raw, _ = req(
+                    fs, "POST", f"/docs/{doc}/ops",
+                    body=json_codec.dumps(Batch(tuple(ops))),
+                    headers={"X-Trace-Id":
+                             f"fleet-smoke-{doc}-{fs.name}"})
+                out = json.loads(raw)
+                assert st == 200 and out["accepted"], (doc, fs.name, out)
+                assert "served_by" in out, "fleet ack must attribute"
+                summary["writes"] += 1
+                if out["served_by"]["name"] != fs.name:
+                    summary["forwarded"] += 1
+                own[(doc, i)] = vals
+        # anti-entropy: one driven round per node converges the fleet
+        for fs in fleet:
+            fs.node.antientropy.sync_now()
+        for doc in doc_ids:
+            fps = set()
+            for i, fs in enumerate(fleet):
+                # read-your-writes through a DIFFERENT server than the
+                # one that took this writer's delta
+                other = fleet[(i + 1) % n_servers]
+                st, raw, hdr = req(other, "GET", f"/docs/{doc}")
+                assert st == 200, (doc, other.name)
+                served = set(json.loads(raw)["values"])
+                missing = [v for v in own[(doc, i)] if v not in served]
+                assert not missing, (doc, fs.name, "via", other.name,
+                                     missing)
+                summary["cross_server_ryw"] += 1
+                for h in ("X-Replica-Id", "X-Replica-Name",
+                          "X-Replica-Epoch", "X-State-Fingerprint",
+                          "X-Commit-Seq", "X-Snapshot-Fingerprint"):
+                    assert h in hdr, (other.name, h)
+                assert hdr["X-Replica-Name"] == other.name
+                fps.add(hdr["X-State-Fingerprint"])
+            assert len(fps) == 1, (doc, "fleet diverged", fps)
+            summary[doc] = {"visible": len(served),
+                            "state_fingerprint": fps.pop()}
+        # every member's scrape surface holds, cluster families included
+        for fs in fleet:
+            st, raw, _ = req(fs, "GET", "/metrics/prom")
+            assert st == 200
+            fams = prom_mod.parse_text(raw.decode())
+            assert "crdt_cluster_members" in fams
+            assert "crdt_cluster_antientropy_sync_age_seconds" in fams
+            st, raw, _ = req(fs, "GET", "/cluster")
+            assert st == 200
+            assert len(json.loads(raw)["members"]) == n_servers
+    finally:
+        for fs in fleet:
+            fs.stop()
+    for fs in fleet:
+        assert not fs.node.engine.scheduler.is_alive(), \
+            f"{fs.name}: scheduler survived shutdown"
+    assert summary["forwarded"] > 0, "no write exercised forwarding"
+    return summary
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    out = run(*(int(a) for a in argv[:3]))
+    if "--fleet" in argv:
+        i = argv.index("--fleet")
+        n = int(argv[i + 1]) if len(argv) > i + 1 else 3
+        out = run_fleet(n_servers=n)
+    else:
+        out = run(*(int(a) for a in argv[:3]))
     print(json.dumps(out), flush=True)
     print("serve_smoke OK", file=sys.stderr)

@@ -259,3 +259,54 @@ def test_wire_ingest_audit_repairs_bad_parser_hints(monkeypatch):
     assert p.hints_vouched
     assert packed_mod.verify_hints(p), "ingest audit must repair hints"
     assert packed_mod.unpack(p) == ops
+
+
+def test_windowed_suffix_matches_operations_since_at_every_boundary():
+    """The anti-entropy window (``engine.packed_since_window``,
+    cluster/antientropy.py's wire) must agree with the reference
+    ``operations_since`` suffix at EVERY Add boundary — including the
+    exactly-equal-timestamp case, where the terminator row itself is
+    served inclusively (the puller's overlap absorbs as a duplicate).
+    Chained bounded windows must reassemble the identical suffix."""
+    from crdt_graph_tpu.codec import json_codec
+
+    ops = []
+    for r in (1, 2):
+        ops.extend(chain_ops(r, 9))
+    # interleave: replica order in the LOG is application order
+    t = engine.init(0)
+    mixed = [op for pair in zip(ops[:9], ops[9:]) for op in pair]
+    for op in mixed:
+        t.apply(op)
+    t.apply(Delete((ts(1, 9),)))            # trailing delete tail
+    full = Batch(tuple(t.operations_since(0).ops))
+    p = packed_mod.pack(full.ops, max_depth=4)
+
+    for boundary in [0] + [op.ts for op in full.ops
+                           if isinstance(op, Add)]:
+        want = t.operations_since(boundary)
+        wire, meta = engine.packed_since_window(p, boundary, 0)
+        assert meta["found"] and not meta["more"]
+        got = json_codec.loads(wire.decode())
+        assert tuple(got.ops) == tuple(want.ops), boundary
+        # bounded windows chain back into the same suffix
+        since, chained = boundary, []
+        for _ in range(40):
+            wire, meta = engine.packed_since_window(p, since, 4)
+            chained.extend(json_codec.loads(wire.decode()).ops)
+            if meta["next_since"] is not None:
+                since = meta["next_since"]
+            if not meta["more"]:
+                break
+        # drop inclusive-terminator overlap rows, keeping first sight
+        seen, dedup = set(), []
+        for op in chained:
+            key = (op.ts if isinstance(op, Add) else ("d", op.path))
+            if key not in seen:
+                seen.add(key)
+                dedup.append(op)
+        assert tuple(dedup) == tuple(want.ops), boundary
+    # a timestamp the log never contained is reported, not silently
+    # treated as "from 0" (the puller resets its own mark)
+    _, meta = engine.packed_since_window(p, ts(5, 5), 4)
+    assert not meta["found"]

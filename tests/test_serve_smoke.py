@@ -18,6 +18,17 @@ _serve_smoke = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_serve_smoke)
 
 
+def test_fleet_smoke_end_to_end():
+    """--fleet mode (ISSUE 7): 3 servers, one write per server, RYW
+    through a DIFFERENT server after anti-entropy, fingerprint-equal
+    reads everywhere, cluster scrape surface on every member."""
+    summary = _serve_smoke.run_fleet(n_servers=3, n_docs=2)
+    assert summary["writes"] == 6
+    assert summary["cross_server_ryw"] == 6
+    assert summary["forwarded"] > 0
+    assert summary["fleet0"]["visible"] == 15      # 3 servers x 5 adds
+
+
 def test_serve_smoke_end_to_end():
     summary = _serve_smoke.run(n_docs=4, writers_per_doc=3, deltas=3,
                                delta_size=8)
