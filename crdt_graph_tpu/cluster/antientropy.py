@@ -41,8 +41,8 @@ import time
 from http.client import HTTPConnection, HTTPException
 from typing import Dict, Optional
 
-from ..obs.trace import (SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
-                         SINCE_NEXT_HEADER)
+from ..obs.trace import (AE_PEER_HEADER, SINCE_FOUND_HEADER,
+                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER)
 from ..serve.metrics import Histogram, LATENCY_BOUNDS_MS
 from ..serve.queue import QueueFull, SchedulerStopped
 
@@ -155,6 +155,15 @@ class AntiEntropy(threading.Thread):
                         st.backoff_until = 0.0
                         st.last_ok = time.monotonic()
                     results[name] = True
+            # fold the marks peers have pulled against US into the
+            # per-doc stability watermark, then let the cascade op-log
+            # advance its checkpoint base / GC cleared segments
+            # (cluster/gateway.py; a failure here must never break
+            # replication — GC is an optimization, the gate is safety)
+            try:
+                self.node.update_stability()
+            except Exception:   # noqa: BLE001 — GC boundary
+                pass
             with self._lock:
                 self.rounds += 1
                 self.round_ms.observe((time.perf_counter() - t0) * 1e3)
@@ -203,8 +212,12 @@ class AntiEntropy(threading.Thread):
                   doc: str) -> None:
         for _ in range(self.max_windows_per_doc):
             since = st.hw.get(doc, 0)
+            # the pull names its node: the peer folds this mark into
+            # its causal-stability watermark (the gate on its op-log's
+            # checkpoint advancement + segment GC — docs/OPLOG.md)
             conn.request("GET", f"/docs/{doc}/ops?since={since}"
-                                f"&limit={self.delta_cap}")
+                                f"&limit={self.delta_cap}",
+                         headers={AE_PEER_HEADER: self.node.name})
             resp = conn.getresponse()
             body = resp.read()
             if resp.status == 404:

@@ -90,6 +90,18 @@ class ClusterNode:
         # process-wide ring (the oracle tags records per node)
         self.engine = engine if engine is not None else ServingEngine(
             flight=flight_mod.FlightRecorder())
+        # fleet mode: served op-logs must NOT auto-stabilize — the
+        # causal-stability watermark (the gate on cascade checkpoint
+        # advancement + segment GC, oplog.py) is derived here from the
+        # anti-entropy marks peers pull with, min'd over the live
+        # lease table, so no replica can be stranded needing collected
+        # ops.  Flipped before any traffic; pre-existing docs (an
+        # embedded engine handed in mid-life) are converted too.
+        self.engine.external_stability = True
+        for d in self.engine.docs():
+            d.tree._log.set_auto_stable(False)
+        self._marks_lock = threading.Lock()
+        self._peer_marks: Dict[str, Dict[str, int]] = {}
         self.leases = LeaseService(kv, ttl_s=ttl_s, max_ids=max_ids,
                                    clock=clock)
         self.lease: Optional[Lease] = None
@@ -262,6 +274,60 @@ class ClusterNode:
 
     def note_forwarded_in(self) -> None:
         self._count("forwarded_in")
+
+    # -- causal-stability watermark (cascade op-log GC gate) ---------------
+
+    def note_peer_mark(self, doc_id: str, peer: str,
+                       since: int) -> None:
+        """Record the ``since`` mark a peer's anti-entropy pull carried
+        (``X-Ae-Peer`` — service/http.py): the peer had consumed our
+        log through that Add when it asked, so positions at or below it
+        are safe to fold once EVERY live peer clears them.  A reset
+        pull (``since=0``) legitimately lowers the mark — the
+        watermark min()s, so the gate only ever errs closed."""
+        with self._marks_lock:
+            self._peer_marks.setdefault(doc_id, {})[peer] = since
+
+    def update_stability(self) -> None:
+        """Fold the recorded peer marks into each served document's
+        stability watermark — min over the LIVE lease table's members
+        (a member that has never pulled holds the watermark at 0, so a
+        fresh joiner is never stranded; a departed member stops
+        counting) — then run the cascade's watermark-gated GC."""
+        members = set(self.members()) - {self.name}
+        docs = self.engine.docs()
+        # prune: marks from departed members (or arbitrary X-Ae-Peer
+        # values — the header is unauthenticated) and from unknown doc
+        # ids must not accumulate forever; only live-member marks for
+        # served docs participate in the watermark anyway
+        with self._marks_lock:
+            doc_ids = {d.doc_id for d in docs}
+            self._peer_marks = {
+                doc: kept
+                for doc, by_peer in self._peer_marks.items()
+                if doc in doc_ids
+                and (kept := {p: m for p, m in by_peer.items()
+                              if p in members})}
+        for d in docs:
+            log = d.tree._log
+            if not log.tiering_enabled:
+                continue
+            if not members:
+                pos = d.tree.log_length
+            else:
+                with self._marks_lock:
+                    marks = dict(self._peer_marks.get(d.doc_id, {}))
+                pos = None
+                for peer in members:
+                    m = marks.get(peer)
+                    if not m:
+                        p_pos = 0
+                    else:
+                        idx = log.index_of_add(m)
+                        p_pos = idx if idx is not None else 0
+                    pos = p_pos if pos is None else min(pos, p_pos)
+            log.set_stable_mark(pos)
+            log.run_gc()
 
     # -- store surface (service/http.py duck type) ------------------------
 

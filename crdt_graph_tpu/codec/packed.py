@@ -728,6 +728,78 @@ def concat_many(parts: Sequence[PackedOps]) -> PackedOps:
     return out
 
 
+def load_packed_npz(path, light: bool = False):
+    """Load one packed-ops npz (the ``engine.write_packed_npz`` wire/
+    disk format) back into a :class:`PackedOps` — the segment-grade
+    loader behind the tiered op log (oplog.py): cold segments and the
+    checkpoint base round-trip through this, re-padded to the jit
+    bucket, hint vouch re-verified on host (and REBUILT on mismatch,
+    same policy as ``TpuTree.restore_packed``).
+
+    Returns ``(p, meta)``; with ``light=True`` only the ``kind``/``ts``
+    columns and the meta decode (the cheap open-time read the cascade
+    uses to build its resident add-timestamp index without pulling a
+    whole segment into memory) — then returns ``(cols_dict, meta)``.
+
+    Every failure mode of a missing, truncated, corrupt, or
+    hand-edited file — including the file not existing at all —
+    raises a typed :class:`~crdt_graph_tpu.core.errors.
+    CheckpointError`: a spilled segment that cannot be read back MUST
+    surface loudly (a silent partial log would serve wrong
+    ``operations_since`` answers and wrong fingerprints forever)."""
+    import json
+    import struct
+    import zipfile
+    import zlib
+    from ..core.errors import CheckpointError
+    try:
+        z = np.load(path)
+        meta = json.loads(bytes(z["meta"]).decode())
+        n = meta.get("num_ops")
+        if not isinstance(n, int) or isinstance(n, bool) or \
+                not (0 <= n <= int(z["kind"].shape[0])):
+            raise ValueError(
+                f"meta num_ops {n!r} inconsistent with column length "
+                f"{int(z['kind'].shape[0])}")
+        if light:
+            return {"kind": z["kind"][:n], "ts": z["ts"][:n]}, meta
+        cols = {k: z[k] for k in
+                ("kind", "ts", "parent_ts", "anchor_ts", "depth",
+                 "paths", "value_ref", "pos")}
+        for k in ("parent_pos", "anchor_pos", "target_pos", "ts_rank"):
+            if k in z.files:
+                cols[k] = z[k]
+        cols = pad_arrays(cols, _bucket(max(n, 1)))
+        p = PackedOps(
+            kind=cols["kind"], ts=cols["ts"],
+            parent_ts=cols["parent_ts"], anchor_ts=cols["anchor_ts"],
+            depth=cols["depth"], paths=cols["paths"],
+            value_ref=cols["value_ref"], pos=cols["pos"],
+            values=json.loads(bytes(z["values"]).decode()),
+            num_ops=n,
+            parent_pos=cols.get("parent_pos"),
+            anchor_pos=cols.get("anchor_pos"),
+            target_pos=cols.get("target_pos"),
+            ts_rank=cols.get("ts_rank"),
+            hints_vouched=bool(meta.get("hints_vouched", False)))
+    except (OSError, zipfile.BadZipFile, zlib.error, KeyError,
+            IndexError, ValueError, TypeError, AttributeError,
+            NotImplementedError, EOFError, struct.error) as e:
+        # OSError covers the MISSING-file case deliberately: unlike a
+        # whole-tree restore (where a bad path is a caller bug), a
+        # segment path comes from the log's own descriptors — its
+        # absence means the spilled history was lost or collected out
+        # from under us, which is exactly a corrupt-checkpoint condition
+        raise CheckpointError(
+            f"op-log segment {getattr(path, 'name', path)!r} unreadable: "
+            f"{type(e).__name__}: {e}") from e
+    # the vouch rides with the columns it vouches for (same hazard as
+    # restore_packed): re-verify before honoring it, rebuild on failure
+    if p.hints_vouched and not verify_hints(p):
+        rebuild_hints(p)
+    return p, meta
+
+
 def pack_json(payload, max_depth: int = DEFAULT_MAX_DEPTH,
               capacity: Optional[int] = None) -> PackedOps:
     """Wire JSON (str/bytes) → :class:`PackedOps`, using the native parser

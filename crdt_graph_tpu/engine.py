@@ -321,6 +321,10 @@ class TpuTree:
         # per-leaf applied mask of the last successful apply — the serving
         # scheduler's attribution channel for fused multi-client batches
         self._last_applied_mask: Optional[np.ndarray] = None
+        # cascade tiering (oplog.py): spills run only at commit
+        # boundaries; a multi-chunk apply defers them so a failing
+        # chunk's rollback target range is always still hot
+        self._defer_spill = False
 
     # -- identity / clocks (parity: CRDTree.elm:130-139, 337-350) ---------
 
@@ -463,7 +467,47 @@ class TpuTree:
                        if isinstance(op, Add)
                        and ts_mod.replica_id(op.ts) == self._replica)
         self._timestamp += own_adds
+        self._after_commit()
         return self
+
+    # -- cascade tiering (oplog.py) ---------------------------------------
+
+    def enable_log_tiering(self, dir: str, *, hot_ops: int = 32768,
+                           hot_bytes: int = 0, gc_min_segs: int = 4,
+                           auto_stable: bool = True,
+                           cache_segments: int = 2,
+                           ephemeral: bool = False) -> "TpuTree":
+        """Arm the op log's three-tier cascade (oplog module
+        docstring): hot ops past the budget spill to packed-npz
+        segments under ``dir`` at commit boundaries, a stability-
+        watermark-gated GC folds them into a checkpoint base, and the
+        full-packing cache drops whenever columns leave memory (it
+        would otherwise keep the whole history resident and defeat the
+        point)."""
+        self._log.enable_tiering(
+            dir, hot_ops=hot_ops, hot_bytes=hot_bytes,
+            gc_min_segs=gc_min_segs, auto_stable=auto_stable,
+            cache_segments=cache_segments, ephemeral=ephemeral,
+            max_depth=self._max_depth, on_spill=self._on_log_spill)
+        return self
+
+    def _on_log_spill(self) -> None:
+        # resident columns moved to disk: holding the monolithic
+        # packing would pin them all in memory anyway
+        self._packed = None
+
+    def log_view(self):
+        """A reference-stable :class:`~crdt_graph_tpu.oplog.LogView`
+        of the applied log — what a published read snapshot pins
+        (serve/snapshot.py)."""
+        return self._log.view(self._max_depth)
+
+    def _after_commit(self) -> None:
+        """Commit-boundary hook: run the cascade's spill/GC unless a
+        batch or chunked apply is mid-flight (their rollback paths
+        truncate back into what must still be the hot tier)."""
+        if self._batch_depth == 0 and not self._defer_spill:
+            self._log.maybe_spill()
 
     def _apply_host(self, leaves: List[Operation]) -> List[Operation]:
         """Sequential host-path apply; first failure rolls everything back
@@ -625,6 +669,7 @@ class TpuTree:
             (kind == packed_mod.KIND_ADD) &
             ((ts_col >> 32) == self._replica)))
         self._last_applied_mask = np.asarray(st == APPLIED)
+        self._after_commit()
         return self
 
     def apply_packed_chunked(self, pnew: PackedOps,
@@ -649,6 +694,10 @@ class TpuTree:
         saved = (self._timestamp, dict(self._replicas),
                  self._last_operation)
         masks: List[np.ndarray] = []
+        # spills defer until the LAST chunk commits: a failing chunk
+        # truncates back to n0, which must still be in the hot tier
+        defer0 = self._defer_spill
+        self._defer_spill = True
         try:
             for s in range(0, n, chunk_ops):
                 chunk = packed_mod.select_rows(
@@ -663,7 +712,10 @@ class TpuTree:
             (self._timestamp, self._replicas,
              self._last_operation) = saved
             self._invalidate()
+            self._defer_spill = defer0
             return self.apply_packed(pnew)
+        finally:
+            self._defer_spill = defer0
         mask = np.concatenate(masks) if masks else np.zeros(0, bool)
         applied = int(mask.sum())
         if applied == n:
@@ -674,6 +726,7 @@ class TpuTree:
         else:
             self._last_operation = Batch(())
         self._last_applied_mask = mask
+        self._after_commit()
         return self
 
     def _apply_kernel(self, leaves: List[Operation]) -> List[Operation]:
@@ -787,6 +840,7 @@ class TpuTree:
         if self._batch_depth == 0 and self._mirror is not None:
             self._mirror.journal.clear()
         self._last_operation = Batch(tuple(acc))
+        self._after_commit()
         return self
 
     def _apply_local(self, op: Operation) -> None:
@@ -1292,6 +1346,81 @@ class TpuTree:
             tree._last_operation = PackedBatch(p, s, e)
         return tree
 
+    def checkpoint_tiered(self, dir: str) -> str:
+        """Tiered checkpoint: the cascade's base + cold segments stay
+        where they are, the hot tail spills to one final segment, and a
+        ``manifest.json`` (tier layout + clocks/cursor meta) makes the
+        directory self-describing — so restore is *checkpoint + tail*
+        (descriptor opens, O(tail) work) instead of a full-history
+        replay.  An untiered tree enables the cascade at ``dir`` first
+        (non-ephemeral: a checkpoint must survive its writer).
+
+        ``last_operation`` is NOT persisted (same policy as the served
+        snapshot wire format): a restoring consumer is bootstrapping,
+        not resuming a half-open batch.  Returns the manifest path.
+
+        ``dir`` is honored even when the cascade is already armed
+        elsewhere (a served document tiers into ephemeral engine
+        scratch): the segment files are then COPIED into ``dir``, so
+        the checkpoint survives the engine that wrote it."""
+        if not self._log.tiering_enabled:
+            self.enable_log_tiering(dir, ephemeral=False)
+        meta = {
+            "replica": self._replica,
+            "timestamp": self._timestamp,
+            "cursor": list(self._cursor),
+            "replicas": {str(k): v for k, v in self._replicas.items()},
+            "max_depth": self._max_depth,
+        }
+        path = self._log.persist(meta, dir=dir)
+        # the hot tail just spilled: drop the monolithic cache like any
+        # other spill (persist bypasses the maybe_spill hook)
+        self._packed = None
+        return path
+
+    @staticmethod
+    def restore_tiered(dir: str, replica: Optional[int] = None,
+                       **tier_kw) -> "TpuTree":
+        """Rebuild a tree from :meth:`checkpoint_tiered` output —
+        O(tail) descriptor opens, no replay, no full column load (cold
+        tiers page in lazily on first read).  ``replica`` adopts a new
+        identity exactly like :meth:`restore_packed`.  Raises
+        :class:`~crdt_graph_tpu.core.errors.CheckpointError` (typed,
+        never a silent partial log) on any missing or corrupt manifest
+        or segment file."""
+        from .core.errors import CheckpointError
+        from .oplog import OpLog
+        if replica is not None:
+            ts_mod.make(replica, 0)
+        log, meta = OpLog.open_dir(dir, **tier_kw)
+        try:
+            rid_meta = meta["replica"]
+            ts_mod.make(int(rid_meta), 0)
+            max_depth = int(meta["max_depth"])
+            if max_depth < 1:
+                raise ValueError(f"max_depth {max_depth}")
+            cursor = tuple(int(c) for c in meta["cursor"])
+            replicas = {int(k): int(v)
+                        for k, v in meta["replicas"].items()}
+            timestamp = int(meta["timestamp"])
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            raise CheckpointError(
+                f"tiered checkpoint meta in {dir!r} invalid: "
+                f"{type(e).__name__}: {e}") from e
+        rid = rid_meta if replica is None else replica
+        tree = TpuTree(rid, max_depth=max_depth)
+        log._cfg.max_depth = max_depth
+        tree._log = log
+        log.set_on_spill(tree._on_log_spill)
+        tree._cursor = cursor
+        tree._replicas = replicas
+        if rid == rid_meta:
+            tree._timestamp = timestamp
+        else:
+            tree._timestamp = max(ts_mod.make(rid, 0),
+                                  replicas.get(rid, 0))
+        return tree
+
 
 def packed_since_bytes(p: PackedOps, initial_timestamp: int) -> bytes:
     """Anti-entropy wire JSON (``GET /ops?since=``) straight off packed
@@ -1368,14 +1497,21 @@ def packed_since_window(p: PackedOps, initial_timestamp: int,
         kinds = p.kind
         window_adds = np.nonzero(
             kinds[start:start + limit] == packed_mod.KIND_ADD)[0]
-        if len(window_adds):
+        # the window must contain an Add BEYOND the resume terminator
+        # (row 0 of a resumed pull is the inclusive ``since`` Add
+        # itself): trimming to it would hand back next_since == since
+        # with more=1 and the chain would re-serve the same window
+        # forever whenever a delete run ≥ limit follows the terminator
+        if len(window_adds) and (initial_timestamp == 0
+                                 or int(window_adds[-1]) > 0):
             # trim so the window ends on its last Add — the resume
             # terminator; the trailing deletes re-serve next window
             stop = start + int(window_adds[-1]) + 1
         else:
-            # all-delete window: extend through the next Add so the
-            # puller still gets a resume point (deletes cannot be
-            # ``since`` terminators)
+            # all-delete window (or only the re-served terminator):
+            # extend through the next Add so the puller still gets a
+            # NEW resume point (deletes cannot be ``since``
+            # terminators)
             later = np.nonzero(
                 kinds[start + limit:n] == packed_mod.KIND_ADD)[0]
             stop = start + limit + int(later[0]) + 1 if len(later) \

@@ -42,11 +42,9 @@ REASON_ORACLE = "oracle"     # a session-guarantee violation (obs/oracle.py)
 REASON_MANUAL = "manual"
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+from ..utils.hostenv import env_int as _env_int  # noqa: E402 — the
+# canonical int-env parser (shared with serve/engine.py's
+# GRAFT_OPLOG_* knobs)
 
 
 def _env_float(name: str, default: float) -> float:

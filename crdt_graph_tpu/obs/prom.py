@@ -176,6 +176,65 @@ def render_engine(engine) -> str:
             w.histogram(name, help_text, h["bounds"], h["counts"],
                         h["count"], h["sum"], {"doc": d.doc_id})
 
+    # -- cascade op-log tiers (oplog.py; docs/OPLOG.md) -------------------
+    # per-tier occupancy/footprint gauges, spill/compaction/GC
+    # counters, the stability watermark, and the cold-segment
+    # load-latency histogram (the restore path's cost signal)
+    oplog_counters = (
+        ("crdt_oplog_spills_total",
+         "Hot-tail spills into cold segments", "spills"),
+        ("crdt_oplog_compactions_total",
+         "Checkpoint-base advancements (cold folds)", "compactions"),
+        ("crdt_oplog_segments_gc_total",
+         "Cold segments folded into the base and collected",
+         "segments_gc"),
+        ("crdt_oplog_segment_loads_total",
+         "Cold segment loads (cache misses)", "segment_loads"),
+    )
+    oplog_gauges = (
+        ("crdt_oplog_resident_bytes",
+         "Estimated resident op-log bytes (hot + indexes + cache)",
+         "resident_bytes"),
+        ("crdt_oplog_stable_mark",
+         "Causal-stability watermark (GC-safe log position)",
+         "stable_mark"),
+        ("crdt_oplog_gc_deferred_segments",
+         "Collected segment files deferred by pinned views",
+         "gc_deferred"),
+    )
+    tele = [(d, d.tree._log.telemetry()) for d in docs]
+    for name, help_text, key in oplog_counters:
+        w.family(name, "counter", help_text)
+        for d, t in tele:
+            w.sample(name, name, t[key], {"doc": d.doc_id})
+    for name, help_text, key in oplog_gauges:
+        w.family(name, "gauge", help_text)
+        for d, t in tele:
+            w.sample(name, name, t[key], {"doc": d.doc_id})
+    w.family("crdt_oplog_tier_ops", "gauge",
+             "Ops held per op-log tier")
+    w.family("crdt_oplog_tier_bytes", "gauge",
+             "Bytes per op-log tier (hot resident, cold/base on disk)")
+    for d, t in tele:
+        for tier, ops_key, bytes_key in (
+                ("hot", "hot_ops", "hot_bytes"),
+                ("cold", "cold_ops", "cold_file_bytes"),
+                ("base", "base_ops", "base_file_bytes")):
+            lbl = {"doc": d.doc_id, "tier": tier}
+            w.sample("crdt_oplog_tier_ops", "crdt_oplog_tier_ops",
+                     t[ops_key], lbl)
+            w.sample("crdt_oplog_tier_bytes", "crdt_oplog_tier_bytes",
+                     t[bytes_key], lbl)
+    w.family("crdt_oplog_segment_load_ms", "histogram",
+             "Cold-segment load latency (the restore path)")
+    for d, t in tele:
+        h = t["load_ms"]
+        if h is not None:
+            w.histogram("crdt_oplog_segment_load_ms",
+                        "Cold-segment load latency (the restore path)",
+                        h["bounds"], h["counts"], h["count"], h["sum"],
+                        {"doc": d.doc_id})
+
     # -- engine-wide scheduler counters ----------------------------------
     for cname, val in sorted(engine.counters.snapshot().items()):
         safe = re.sub(r"[^a-zA-Z0-9_]", "_", cname)

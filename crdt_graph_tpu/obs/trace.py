@@ -54,6 +54,12 @@ SINCE_NEXT_HEADER = "X-Since-Next"
 SINCE_MORE_HEADER = "X-Since-More"
 SINCE_FOUND_HEADER = "X-Since-Found"
 FORWARDED_HEADER = "X-Fleet-Forwarded"
+# anti-entropy pull attribution: the puller names itself so the
+# serving node can fold the pull's ``since`` mark into its causal-
+# stability watermark (min acked position across the fleet — what
+# gates the cascade op-log's checkpoint advancement and segment GC;
+# oplog.py, cluster/gateway.py update_stability)
+AE_PEER_HEADER = "X-Ae-Peer"
 
 # accepted client-supplied ids: 8-64 url-safe chars (anything else is
 # re-minted — the id lands in filenames and label values)

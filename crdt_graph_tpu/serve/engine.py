@@ -27,6 +27,7 @@ so every client reads its own writes.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -65,6 +66,15 @@ WIRE_FAST_BYTES = 1 << 20
 # so no single launch (or jit bucket) is sized by the largest client
 DEFAULT_CHUNK_OPS = 1 << 17
 
+# cascade op-log defaults (oplog.py; docs/OPLOG.md): served documents
+# tier their logs by default so long-lived docs and sustained write
+# traffic keep O(hot window) resident log bytes.  GRAFT_OPLOG_HOT_OPS=0
+# disables tiering entirely.
+DEFAULT_OPLOG_HOT_OPS = 32768
+
+from ..utils.hostenv import env_int as _env_int  # noqa: E402 — the
+# canonical int-env parser (shared with obs/flight.py's sizing knobs)
+
 
 class ServedDoc:
     """One served document: engine tree (scheduler-owned), write queue,
@@ -76,6 +86,23 @@ class ServedDoc:
         self.doc_id = doc_id
         self._engine = engine
         self.tree = engine_mod.init(SERVER_REPLICA, max_depth=max_depth)
+        if engine.oplog_hot_ops > 0:
+            # cascade tiering (oplog.py): hot tail in memory, sealed
+            # cold segments on scratch disk, watermark-gated GC.  A
+            # fleet node (cluster/gateway.py) turns auto-stability off
+            # and feeds explicit anti-entropy watermarks instead.
+            # The subdir is PREFIXED: the wire route's doc-id charset
+            # ([A-Za-z0-9_.-]) admits "." and ".." verbatim, which as
+            # bare path components would alias (or escape) the
+            # engine-owned spill root; "doc-.." is just a filename.
+            self.tree.enable_log_tiering(
+                os.path.join(engine.oplog_dir, f"doc-{doc_id}"),
+                hot_ops=engine.oplog_hot_ops,
+                hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
+                gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
+                auto_stable=not engine.external_stability,
+                cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
+                ephemeral=True)
         self.queue = DocQueue(max_requests=engine.max_queue_requests,
                               max_leaves=engine.max_queue_leaves)
         self.next_replica = 1
@@ -194,6 +221,8 @@ class ServedDoc:
             "chunks_launched": self.chunks_launched,
             "commit_latency_ms": self.commit_ms.snapshot(),
             "coalesce_width": self.coalesce_width.snapshot(),
+            # cascade op-log tier state (oplog.py; docs/OPLOG.md)
+            "oplog": self.tree._log.telemetry(),
         }
 
 
@@ -210,6 +239,8 @@ class ServingEngine:
                  cross_doc: bool = True,
                  wire_fast_bytes: int = WIRE_FAST_BYTES,
                  submit_timeout_s: float = 600.0,
+                 oplog_hot_ops: Optional[int] = None,
+                 oplog_dir: Optional[str] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
                  start: bool = True):
@@ -217,6 +248,21 @@ class ServingEngine:
         self._docs: Dict[str, ServedDoc] = {}
         self._lock = threading.Lock()
         self._max_depth = max_depth
+        # cascade op-log (oplog.py): on by default; 0 disables.  The
+        # spill scratch dir is per-engine (one subdir per document) and
+        # removed with the engine when it was auto-created.
+        self.oplog_hot_ops = oplog_hot_ops if oplog_hot_ops is not None \
+            else _env_int("GRAFT_OPLOG_HOT_OPS", DEFAULT_OPLOG_HOT_OPS)
+        self._own_oplog_dir = False
+        self.oplog_dir = oplog_dir or os.environ.get("GRAFT_OPLOG_DIR")
+        if self.oplog_hot_ops > 0 and self.oplog_dir is None:
+            import tempfile
+            self.oplog_dir = tempfile.mkdtemp(prefix="graft-oplog-")
+            self._own_oplog_dir = True
+        # a fleet gateway flips this ON before traffic so served logs
+        # wait for explicit anti-entropy stability watermarks instead
+        # of auto-stabilizing (cluster/gateway.py)
+        self.external_stability = False
         self.max_queue_requests = max_queue_requests
         self.max_queue_leaves = max_queue_leaves
         self.chunk_ops = chunk_ops
@@ -447,5 +493,14 @@ class ServingEngine:
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop the scheduler and fail any unresolved tickets (503) —
-        clean shutdown never leaves a handler thread blocked."""
+        clean shutdown never leaves a handler thread blocked.  The
+        documents' ephemeral spill tiers are deleted with the engine."""
         self.scheduler.shutdown(timeout=timeout)
+        for d in self.docs():
+            try:
+                d.tree._log.close()
+            except Exception:   # noqa: BLE001 — shutdown boundary
+                pass
+        if self._own_oplog_dir:
+            import shutil
+            shutil.rmtree(self.oplog_dir, ignore_errors=True)

@@ -2,7 +2,7 @@
 
 The engine's node tables are persistent values, so snapshot isolation is
 a pointer swap: on every merge commit the scheduler derives a
-:class:`DocSnapshot` — packed op columns, vector clock, visible value
+:class:`DocSnapshot` — a pinned op-log view, vector clock, visible value
 sequence — and publishes it with one attribute store (atomic under the
 GIL).  Readers (``GET /docs/{id}``, ``/ops?since=``, ``/clock``,
 ``/snapshot``) resolve entirely against the snapshot they loaded: they
@@ -13,6 +13,17 @@ consistency story, and it is the strongest one a pull-based CRDT service
 needs: every snapshot is a real replica state (a prefix of the applied
 log), and successive snapshots are monotonically ordered by ``seq``
 (single-writer scheduler).
+
+Since the cascade op-log (oplog.py), what a snapshot pins is a
+**reference-stable** :class:`~crdt_graph_tpu.oplog.LogView` rather than
+one monolithic column set: the tiered log may spill hot ops to disk,
+advance its checkpoint base, or GC cold segments while this snapshot is
+being served, and none of that can shift, re-serve, or lose a window an
+anti-entropy chain is mid-way through — the view keeps serving the
+exact rows (and files) it captured at publish time.  Deriving a
+snapshot is O(segments) descriptor capture; full-column reassembly
+(``/snapshot`` bootstraps, unbounded ``/ops?since=``) happens lazily
+and is cached per snapshot generation.
 
 Derivation cost sits on the COMMIT path (the scheduler pre-warms the
 visible-value sequence before publishing), so the first read after a
@@ -25,38 +36,50 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import engine as engine_mod
-from ..codec import packed as packed_mod
+from ..oplog import LogView
 
 
 class DocSnapshot:
     """One immutable published read view.  All fields are frozen at
-    construction; the packed columns are shared with the engine under
-    the ``packed_state`` immutability contract (engine.TpuTree)."""
+    construction; the pinned log view is reference-stable by the
+    cascade contract (oplog.LogView)."""
 
-    __slots__ = ("doc_id", "seq", "packed", "values", "clock", "replica",
+    __slots__ = ("doc_id", "seq", "view", "values", "clock", "replica",
                  "timestamp", "cursor", "max_depth", "log_length",
                  "log_segments", "committed_at", "_fp", "_sfp")
 
-    def __init__(self, doc_id: str, seq: int, packed: packed_mod.PackedOps,
+    def __init__(self, doc_id: str, seq: int, view: LogView,
                  values: Tuple[Any, ...], clock: Dict[int, int],
                  replica: int, timestamp: int, cursor: Tuple[int, ...],
-                 max_depth: int, log_length: int, log_segments: int = 0):
+                 max_depth: int):
         self.doc_id = doc_id
         self.seq = seq
-        self.packed = packed
+        self.view = view
         self.values = values
         self.clock = clock
         self.replica = replica
         self.timestamp = timestamp
         self.cursor = cursor
         self.max_depth = max_depth
-        self.log_length = log_length
-        self.log_segments = log_segments
+        # the LOGICAL op extent: checkpoint base + cold + hot tail —
+        # identical across replicas (and tier layouts) holding the same
+        # op set, because nothing is ever dropped logically
+        self.log_length = view.length
+        self.log_segments = view.num_segments
         self.committed_at = time.time()
         self._fp: Optional[str] = None
         self._sfp: Optional[str] = None
 
     # -- read endpoints ---------------------------------------------------
+
+    @property
+    def packed(self):
+        """The full column set, reassembled lazily from the pinned
+        view and cached per snapshot generation (cold tiers load
+        through the log's LRU).  Only the full-log consumers
+        (``/snapshot`` bootstrap, unbounded ``/ops?since=``) pay it —
+        windowed serving touches just the window's segments."""
+        return self.view.to_packed()
 
     def visible_values(self) -> List[Any]:
         return list(self.values)
@@ -90,13 +113,16 @@ class DocSnapshot:
         local ``seq``, which counts that server's commits), so two
         fleet replicas of the same document never agree on it even
         when fully converged.  This one hashes only what the CRDT
-        itself determines — the vector clock, the applied-op count
-        (duplicates absorb before the log, so equal op sets give equal
-        counts), and the materialized visible sequence — so converged
-        replicas agree on it regardless of how many commits each took
-        to get there.  The fleet convergence oracle and the chaos
-        tests compare THIS across servers.  Cached; the O(visible)
-        hash is paid at most once per published snapshot."""
+        itself determines — the vector clock, the LOGICAL applied-op
+        extent (checkpoint base + tail, ``view.length`` — NOT the
+        physical tier layout, which legitimately differs between a
+        replica that has spilled/compacted and one that hasn't), and
+        the materialized visible sequence — so converged replicas
+        agree on it regardless of how many commits each took to get
+        there or how their logs are tiered on disk.  The fleet
+        convergence oracle and the chaos tests compare THIS across
+        servers.  Cached; the O(visible) hash is paid at most once per
+        published snapshot."""
         if self._sfp is None:
             import hashlib
             h = hashlib.sha1()
@@ -106,20 +132,20 @@ class DocSnapshot:
         return self._sfp
 
     def ops_since_window(self, since: int, limit: int = 0):
-        """Bounded resumable anti-entropy window
-        (``engine.packed_since_window`` over the snapshot's immutable
-        columns): ``(wire_bytes, {"found", "more", "next_since",
-        "count"})``."""
-        return engine_mod.packed_since_window(self.packed, since, limit)
+        """Bounded resumable anti-entropy window off the pinned view:
+        ``(wire_bytes, {"found", "more", "next_since", "count"})`` —
+        byte-identical to ``engine.packed_since_window`` over the
+        untiered full packing, at every tier seam (oplog.LogView
+        window contract)."""
+        return self.view.window(since, limit)
 
     def ops_since_bytes(self, since: int) -> bytes:
-        """Wire JSON for ``GET /ops?since=`` straight off the snapshot's
-        columns — the SAME egress helper the live tree uses
-        (``engine.packed_since_bytes``, byte-identical output), minus
-        the live tree: the packed columns and their cached ts index are
-        immutable, so any number of readers can serve pulls
-        concurrently while a merge is in flight."""
-        return engine_mod.packed_since_bytes(self.packed, since)
+        """Wire JSON for ``GET /ops?since=`` off the pinned view — the
+        SAME egress bytes the live tree serves
+        (``engine.packed_since_bytes``): the view's descriptors and
+        indexes are immutable, so any number of readers can serve
+        pulls concurrently while a merge (or a spill) is in flight."""
+        return self.view.since_bytes(since)
 
     def checkpoint_bytes(self, compress: bool = False) -> bytes:
         """The binary packed-checkpoint bytes (``GET /snapshot``), built
@@ -131,20 +157,20 @@ class DocSnapshot:
         bootstrapping client adopts its own identity and has no use for
         the server's last locally-applied batch."""
         import io
+        p = self.packed
         meta = {
             "replica": self.replica,
             "timestamp": self.timestamp,
             "cursor": list(self.cursor),
             "replicas": {str(k): v for k, v in self.clock.items()},
             "max_depth": self.max_depth,
-            "num_ops": self.packed.num_ops,
-            "hints_vouched": self.packed.hints_vouched,
+            "num_ops": p.num_ops,
+            "hints_vouched": p.hints_vouched,
             "last_op_span": [self.log_length, self.log_length],
             "last_op_bare": False,
         }
         buf = io.BytesIO()
-        engine_mod.write_packed_npz(buf, self.packed, meta,
-                                    compress=compress)
+        engine_mod.write_packed_npz(buf, p, meta, compress=compress)
         return buf.getvalue()
 
     def __repr__(self) -> str:
@@ -159,16 +185,17 @@ def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree"
     merged requests, so a client's follow-up read always sees its own
     write.  ``visible_values`` is the pre-warm: it forces the host
     mirror once here so no reader ever pays the first-read
-    materialization."""
+    materialization.  The log view capture is O(segments) — deriving a
+    snapshot no longer re-packs the whole history on host-path commits,
+    and never holds more of the log resident than the cascade already
+    does."""
     return DocSnapshot(
         doc_id=doc_id, seq=seq,
-        packed=tree.packed_state(),
+        view=tree.log_view(),
         values=tuple(tree.visible_values()),
         clock=dict(tree._replicas),
         replica=tree.replica_id,
         timestamp=tree.timestamp,
         cursor=tuple(tree.cursor),
         max_depth=tree._max_depth,
-        log_length=tree.log_length,
-        log_segments=tree._log.num_segments,
     )

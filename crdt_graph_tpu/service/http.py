@@ -76,10 +76,11 @@ from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
 from ..obs import prom as prom_mod
-from ..obs.trace import (COMMIT_SEQ_HEADER, FORWARDED_HEADER,
-                         SESSION_HEADER, SINCE_FOUND_HEADER,
-                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER,
-                         SNAP_FP_HEADER, TRACE_HEADER, ensure_session_id,
+from ..obs.trace import (AE_PEER_HEADER, COMMIT_SEQ_HEADER,
+                         FORWARDED_HEADER, SESSION_HEADER,
+                         SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
+                         SINCE_NEXT_HEADER, SNAP_FP_HEADER,
+                         TRACE_HEADER, ensure_session_id,
                          ensure_trace_id, is_valid_id)
 from ..cluster.gateway import ForwardError
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
@@ -210,6 +211,14 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     self._send(400, {"error": "since and limit must "
                                               "be integers"})
                     return
+                # a pull that names its fleet node (X-Ae-Peer) feeds
+                # the causal-stability watermark: the peer provably
+                # consumed our log through `since`, which is what
+                # gates the cascade op-log's checkpoint advancement
+                # and segment GC (cluster/gateway.py, docs/OPLOG.md)
+                peer = self.headers.get(AE_PEER_HEADER)
+                if peer and hasattr(store, "note_peer_mark"):
+                    store.note_peer_mark(doc_id, peer, since)
                 # pre-encoded fast path: the bootstrap contract serves
                 # the full log, so avoid a json.loads/dumps round trip.
                 # With ?limit= (anti-entropy pulls) the window is

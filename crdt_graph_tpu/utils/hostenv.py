@@ -18,6 +18,18 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+def env_int(name: str, default: int) -> int:
+    """One integer env knob, falling back to ``default`` on absence OR
+    malformed content — the single parser behind the GRAFT_OPLOG_*
+    (serve/engine.py) and GRAFT_FLIGHT_*/GRAFT_OBS_* (obs/flight.py)
+    sizing knobs, so a typo'd value degrades to the documented default
+    instead of crashing process start."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def flag_on(name: str, default: str = "1") -> bool:
     """One boolean env flag, read at TRACE time and logged on every
     (re)trace — the single parser behind the GRAFT_FUSED_* and
