@@ -105,6 +105,10 @@ class _Session(threading.Thread):
         self.leaves_acked = 0
         self.shed_429 = 0
         self.read_ms: List[float] = []
+        # per-acked-write latency of the SUCCESSFUL attempt (parse +
+        # queue + merge + WAL append/fsync + publish): the number the
+        # WAL headline bench prices the durability tax with
+        self.ack_ms: List[float] = []
         self.errors: List[str] = []
         self._conn: Optional[HTTPConnection] = None
 
@@ -179,9 +183,11 @@ class _Session(threading.Thread):
         n_leaves = len(delta.ops)
         deadline = time.monotonic() + self.h.cfg.read_timeout_s
         while True:
+            t0 = time.perf_counter()
             resp, raw = self._request(
                 "POST", f"/docs/{self.doc}/ops", body=body,
                 headers={TRACE_HEADER: tid, SESSION_HEADER: self.sid})
+            ack_ms = (time.perf_counter() - t0) * 1e3
             if resp.status == 200:
                 out = json.loads(raw)
                 if not out.get("accepted") or \
@@ -191,6 +197,7 @@ class _Session(threading.Thread):
                 self.h.oracle.observe_write_ack(self.sid, self.doc, tid)
                 self.writes_acked += 1
                 self.leaves_acked += n_leaves
+                self.ack_ms.append(ack_ms)
                 return True
             if resp.status == 429:
                 # interleaved reads during shedding: session
@@ -382,9 +389,11 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     violations = oracle.finalize()
 
     read_ms = sorted(m for s in sessions for m in s.read_ms)
+    ack_ms = sorted(m for s in sessions for m in s.ack_ms)
     errors = [e for s in sessions for e in s.errors] + giant_err
     merged = sum(d.ops_merged for d in engine.docs())
     n = len(read_ms)
+    na = len(ack_ms)
     ost = oracle.stats()
     out = {
         "harness": "loadgen",
@@ -402,6 +411,20 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         "read_p50_ms": round(read_ms[n // 2], 3) if n else None,
         "read_p99_ms": round(read_ms[(99 * n) // 100], 3) if n else None,
         "read_max_ms": round(read_ms[-1], 3) if n else None,
+        # ack latency of successful writes (durability tax visible
+        # here when a WAL is armed: + wal_append + wal_fsync)
+        "ack_p50_ms": round(ack_ms[na // 2], 3) if na else None,
+        "ack_p99_ms": round(ack_ms[min(na - 1, (99 * na) // 100)], 3)
+        if na else None,
+        "wal_sync": engine.wal_sync
+        if engine.durable_dir is not None else "off",
+        "wal": ({"fsyncs": sum((d.wal.telemetry()["fsyncs"])
+                               for d in engine.docs()
+                               if d.wal is not None),
+                 "appends": sum((d.wal.telemetry()["appends"])
+                                for d in engine.docs()
+                                if d.wal is not None)}
+                if engine.durable_dir is not None else None),
         "shed_429": sum(s.shed_429 for s in sessions),
         "giant_ops": cfg.giant_ops,
         "giant_commit_s": round(giant_s, 3) if giant_s else None,

@@ -30,6 +30,14 @@ def main(argv=None) -> int:
     ap.add_argument("--ttl", type=float, default=5.0)
     ap.add_argument("--ae-interval", type=float, default=0.25)
     ap.add_argument("--delta-cap", type=int, default=65_536)
+    ap.add_argument("--durable-dir", default=None,
+                    help="crash-durable acked writes: per-doc tier "
+                         "manifests + a group-commit WAL under this "
+                         "dir; a restart recovers to serving with "
+                         "zero acked-write loss (docs/DURABILITY.md)")
+    ap.add_argument("--wal-sync", default="batch",
+                    choices=("commit", "batch", "off"),
+                    help="WAL fsync policy (only with --durable-dir)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin this node to the host CPU backend "
                          "(localhost test fleets: scrubs the TPU "
@@ -48,12 +56,23 @@ def main(argv=None) -> int:
 
     from . import FileKV, FleetServer
 
+    engine = None
+    if args.durable_dir:
+        from ..obs import flight as flight_mod
+        from ..serve import ServingEngine
+        engine = ServingEngine(durable_dir=args.durable_dir,
+                               wal_sync=args.wal_sync,
+                               flight=flight_mod.FlightRecorder())
     fs = FleetServer(args.name, FileKV(args.kv_dir), port=args.port,
+                     engine=engine,
                      ttl_s=args.ttl, ae_interval_s=args.ae_interval,
                      delta_cap=args.delta_cap)
     print("READY " + json.dumps(
         {"name": fs.name, "addr": fs.addr,
-         "id": fs.node.node_id(), "epoch": fs.node.epoch()}),
+         "id": fs.node.node_id(), "epoch": fs.node.epoch(),
+         "durable": bool(args.durable_dir),
+         "recovered_docs": sorted(
+             d.doc_id for d in fs.node.engine.docs() if d.recovered)}),
         flush=True)
 
     done = threading.Event()

@@ -56,11 +56,15 @@ class _PeerFailure(Exception):
 class _PeerState:
     __slots__ = ("addr", "hw", "hw_digest", "pulls", "ops_applied",
                  "dup_windows_skipped", "failures", "fail_streak",
-                 "backoff_until", "last_ok", "last_err")
+                 "backoff_until", "last_ok", "last_err", "known_docs")
 
     def __init__(self, addr: str):
         self.addr = addr
         self.hw: Dict[str, int] = {}     # doc -> last Add ts served
+        # the peer's /docs listing from the last successful round —
+        # how a rejoining node knows a document it doesn't hold yet
+        # EXISTS somewhere (the read path's 503-instead-of-404 hint)
+        self.known_docs: frozenset = frozenset()
         # doc -> (since, sha1(body)) of the last window APPLIED from
         # this peer: `operations_since` serves the terminator row
         # inclusively, so at steady state every round re-serves a
@@ -101,6 +105,7 @@ class AntiEntropy(threading.Thread):
         self.max_windows_per_doc = max_windows_per_doc
         self._rng = random.Random(seed)
         self._stop = threading.Event()
+        self._wake = threading.Event()
         self._round_lock = threading.Lock()
         self._peers: Dict[str, _PeerState] = {}
         self._lock = threading.Lock()    # guards _peers + counters
@@ -108,17 +113,42 @@ class AntiEntropy(threading.Thread):
         self.round_ms = Histogram(LATENCY_BOUNDS_MS)
         self._trace_n = 0
         self.local_shed = 0
+        self.priority_pulls = 0
+        self._last_priority_wake = 0.0
         self.started_at = time.monotonic()
 
     # -- lifecycle --------------------------------------------------------
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
+
+    def request_priority(self, doc: str) -> None:
+        """A read just 503'd for ``doc`` (catch-up window): wake the
+        daemon NOW instead of waiting out the interval, and ignore
+        peer backoff for the round — the requested document is pulled
+        with everything else the round covers.  Rate-limited to one
+        immediate wake per second: clients polling their Retry-After
+        must not turn every 503 into a back-to-back full sync round
+        that hammers backing-off (possibly failing) peers."""
+        now = time.monotonic()
+        with self._lock:
+            self.priority_pulls += 1
+            if now - self._last_priority_wake < 1.0:
+                return
+            self._last_priority_wake = now
+        self._wake.set()
 
     def run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while True:
+            woken = self._wake.wait(self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
-                self.sync_now(respect_backoff=True)
+                # a priority wake overrides per-peer backoff: the doc
+                # the reader wants may live behind a backing-off peer
+                self.sync_now(respect_backoff=not woken)
             except Exception:   # noqa: BLE001 — daemon boundary: a bug
                 pass            # must not kill replication for good
 
@@ -203,7 +233,10 @@ class AntiEntropy(threading.Thread):
             body = resp.read()
             if resp.status != 200:
                 raise _PeerFailure(f"GET /docs -> {resp.status}")
-            for doc in json.loads(body)["docs"]:
+            docs = json.loads(body)["docs"]
+            with self._lock:
+                st.known_docs = frozenset(docs)
+            for doc in docs:
                 self._pull_doc(conn, st, doc)
         finally:
             conn.close()
@@ -280,6 +313,15 @@ class AntiEntropy(threading.Thread):
                                f"doc {doc!r}")
         return op_mod.count(applied)
 
+    def peers_with(self, doc: str) -> list:
+        """Live-peer names whose last ``/docs`` listing included
+        ``doc`` — evidence the document exists somewhere even though
+        this node doesn't hold it (yet)."""
+        members = set(self.node.members()) - {self.node.name}
+        with self._lock:
+            return sorted(name for name, st in self._peers.items()
+                          if name in members and doc in st.known_docs)
+
     # -- exposition -------------------------------------------------------
 
     def stats(self) -> Dict:
@@ -315,5 +357,6 @@ class AntiEntropy(threading.Thread):
                 "round_ms": self.round_ms.snapshot(),
                 "round_ms_export": self.round_ms.export(),
                 "local_shed": self.local_shed,
+                "priority_pulls": self.priority_pulls,
                 "peers": peers,
             }

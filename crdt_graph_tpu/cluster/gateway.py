@@ -275,6 +275,27 @@ class ClusterNode:
     def note_forwarded_in(self) -> None:
         self._count("forwarded_in")
 
+    # -- rejoining-node catch-up (ISSUE 9) ---------------------------------
+
+    def catchup_status(self, doc_id: str) -> Optional[Dict[str, int]]:
+        """A read asked for a document this node doesn't hold.  If a
+        live peer's ``/docs`` listing includes it, the document EXISTS
+        and this node is merely behind (a restart, or fresh ownership
+        after a rebalance): trigger a priority anti-entropy pull and
+        return the 503 hint the HTTP layer serves instead of a 404 —
+        ``retry_after_s`` (one-ish sync interval) and ``remaining``
+        (the best local estimate of ops still to pull: the
+        peer-holding count until the first window lands, after which
+        the doc exists locally and reads stop landing here).  None =
+        no peer has it either — a genuine 404."""
+        peers = self.antientropy.peers_with(doc_id)
+        if not peers:
+            return None
+        self._count("catchup_503")
+        self.antientropy.request_priority(doc_id)
+        retry = max(1, int(self.antientropy.interval_s * 2 + 0.999))
+        return {"retry_after_s": retry, "remaining": len(peers)}
+
     # -- causal-stability watermark (cascade op-log GC gate) ---------------
 
     def note_peer_mark(self, doc_id: str, peer: str,

@@ -476,7 +476,8 @@ class TpuTree:
                            hot_bytes: int = 0, gc_min_segs: int = 4,
                            auto_stable: bool = True,
                            cache_segments: int = 2,
-                           ephemeral: bool = False) -> "TpuTree":
+                           ephemeral: bool = False,
+                           durable: bool = False) -> "TpuTree":
         """Arm the op log's three-tier cascade (oplog module
         docstring): hot ops past the budget spill to packed-npz
         segments under ``dir`` at commit boundaries, a stability-
@@ -488,8 +489,45 @@ class TpuTree:
             dir, hot_ops=hot_ops, hot_bytes=hot_bytes,
             gc_min_segs=gc_min_segs, auto_stable=auto_stable,
             cache_segments=cache_segments, ephemeral=ephemeral,
-            max_depth=self._max_depth, on_spill=self._on_log_spill)
+            max_depth=self._max_depth, on_spill=self._on_log_spill,
+            durable=durable)
         return self
+
+    def begin_commit(self) -> tuple:
+        """Snapshot the pre-commit state the WAL shed path needs to
+        roll one commit back (serve/scheduler.py): a merge whose WAL
+        record cannot be made durable (ENOSPC/EIO) must leave the
+        replica untouched, or the log would hold ops that exist in
+        neither the tiers nor the WAL — and a later acked write could
+        causally depend on them, turning a disk hiccup into acked loss
+        at the next crash."""
+        return (len(self._log), self._timestamp, dict(self._replicas),
+                self._last_operation)
+
+    def rollback_commit(self, saved: tuple) -> None:
+        """Undo everything since :meth:`begin_commit` (the chunked-
+        apply rollback recipe: truncate the log, restore clocks and
+        provenance, invalidate the materialized view)."""
+        n0, timestamp, replicas, last_op = saved
+        self._log.truncate(n0)
+        self._timestamp = timestamp
+        self._replicas = replicas
+        self._last_operation = last_op
+        self._invalidate()
+
+    def manifest_meta(self) -> dict:
+        """The clock/cursor meta a durable tier manifest carries —
+        exactly what :meth:`checkpoint_tiered` persists, minus the
+        last-operation span (a LIVE manifest is written mid-flight at
+        spill boundaries; WAL replay rebuilds ``last_operation`` from
+        the final record, so the span would be dead weight)."""
+        return {
+            "replica": self._replica,
+            "timestamp": self._timestamp,
+            "cursor": list(self._cursor),
+            "replicas": {str(k): v for k, v in self._replicas.items()},
+            "max_depth": self._max_depth,
+        }
 
     def _on_log_spill(self) -> None:
         # resident columns moved to disk: holding the monolithic
@@ -1147,13 +1185,30 @@ class TpuTree:
             "num_ops": p.num_ops,
             "hints_vouched": p.hints_vouched,
         }
-        # last_operation is (by construction of apply/batch) the ops just
-        # appended to the log, so persist the row SPAN, not the encoded
-        # blob — after a bootstrap-size merge the blob alone was larger
-        # than every column combined (73 MB at 1M ops).  Anything that
-        # breaks the suffix invariant falls back to the full encode.
+        self._last_op_meta(meta)
+        write_packed_npz(path, p, meta, compress=compress)
+
+    def _last_op_meta(self, meta: dict) -> None:
+        """Stamp ``last_operation`` provenance into a checkpoint
+        ``meta`` — shared by :meth:`checkpoint_packed` and
+        :meth:`checkpoint_tiered`, whose restore paths consume the
+        same keys.  last_operation is (by construction of apply/batch)
+        the ops just appended to the log, so persist the row SPAN, not
+        the encoded blob — after a bootstrap-size merge the blob alone
+        was larger than every column combined (73 MB at 1M ops).
+        Anything that breaks the suffix invariant falls back to the
+        full encode."""
+        from .codec import json_codec
+        from .oplog import ViewSpanBatch
         lo = self._last_operation
-        if isinstance(lo, PackedBatch) and self._log.tail_is(lo):
+        if isinstance(lo, ViewSpanBatch):
+            # a restored-then-unchanged tree: the span is already log
+            # positions of THIS log — re-emit it O(1) instead of
+            # materializing a possibly-cold-tier-sized batch twice
+            # just to re-derive the numbers it carries
+            meta["last_op_span"] = [lo._start, lo._stop]
+            meta["last_op_bare"] = False
+        elif isinstance(lo, PackedBatch) and self._log.tail_is(lo):
             # columnar commit: the batch IS the log's final column
             # segment by construction — O(1), no materialization
             meta["last_op_span"] = [len(self._log) - lo.num_leaves,
@@ -1171,7 +1226,6 @@ class TpuTree:
                 meta["last_op_bare"] = not isinstance(lo, Batch)
             else:
                 meta["last_operation"] = json_codec.encode(lo)
-        write_packed_npz(path, p, meta, compress=compress)
 
     @staticmethod
     def restore_packed(path, replica: Optional[int] = None) -> "TpuTree":
@@ -1365,13 +1419,12 @@ class TpuTree:
         the checkpoint survives the engine that wrote it."""
         if not self._log.tiering_enabled:
             self.enable_log_tiering(dir, ephemeral=False)
-        meta = {
-            "replica": self._replica,
-            "timestamp": self._timestamp,
-            "cursor": list(self._cursor),
-            "replicas": {str(k): v for k, v in self._replicas.items()},
-            "max_depth": self._max_depth,
-        }
+        meta = self.manifest_meta()
+        # persist last_operation provenance (shared _last_op_meta
+        # policy with checkpoint_packed): a restored node's op
+        # provenance then survives the round trip instead of silently
+        # resetting to an empty batch (ISSUE 9 satellite)
+        self._last_op_meta(meta)
         path = self._log.persist(meta, dir=dir)
         # the hot tail just spilled: drop the monolithic cache like any
         # other spill (persist bypasses the maybe_spill hook)
@@ -1388,8 +1441,9 @@ class TpuTree:
         :class:`~crdt_graph_tpu.core.errors.CheckpointError` (typed,
         never a silent partial log) on any missing or corrupt manifest
         or segment file."""
+        from .codec import json_codec
         from .core.errors import CheckpointError
-        from .oplog import OpLog
+        from .oplog import OpLog, ViewSpanBatch
         if replica is not None:
             ts_mod.make(replica, 0)
         log, meta = OpLog.open_dir(dir, **tier_kw)
@@ -1403,6 +1457,18 @@ class TpuTree:
             replicas = {int(k): int(v)
                         for k, v in meta["replicas"].items()}
             timestamp = int(meta["timestamp"])
+            last_op: Optional[Operation] = None
+            span = meta.get("last_op_span")
+            if span is not None:
+                if not (isinstance(span, list) and len(span) == 2
+                        and all(isinstance(x, int)
+                                and not isinstance(x, bool)
+                                for x in span)
+                        and 0 <= span[0] <= span[1] <= len(log)):
+                    raise ValueError(f"last_op_span {span!r} outside "
+                                     f"the {len(log)}-op log")
+            elif "last_operation" in meta:
+                last_op = json_codec.decode(meta["last_operation"])
         except (KeyError, ValueError, TypeError, AttributeError) as e:
             raise CheckpointError(
                 f"tiered checkpoint meta in {dir!r} invalid: "
@@ -1419,6 +1485,22 @@ class TpuTree:
         else:
             tree._timestamp = max(ts_mod.make(rid, 0),
                                   replicas.get(rid, 0))
+        # last_operation round-trips (ISSUE 9 satellite): a span
+        # rebuilds LAZILY off the restored view (the span may be a
+        # whole bootstrap ingest living in cold segments — restore
+        # must stay O(tail)); a bare single op materializes eagerly so
+        # the restored echo keeps the reference's bare-op shape; old
+        # manifests without either key keep the empty-batch sentinel.
+        if span is not None:
+            s, e = span
+            if e > s:
+                vb = ViewSpanBatch(log.view(max_depth), s, e)
+                if meta.get("last_op_bare") and e - s == 1:
+                    tree._last_operation = vb.ops[0]
+                else:
+                    tree._last_operation = vb
+        elif last_op is not None:
+            tree._last_operation = last_op
         return tree
 
 

@@ -235,6 +235,55 @@ def render_engine(engine) -> str:
                         h["bounds"], h["counts"], h["count"], h["sum"],
                         {"doc": d.doc_id})
 
+    # -- write-ahead log (wal.py; docs/DURABILITY.md) ---------------------
+    # rendered only when at least one document is durable, so the
+    # default ephemeral engine's scrape is unchanged
+    wdocs = [(d, d.wal.telemetry()) for d in docs if d.wal is not None]
+    if wdocs:
+        wal_counters = (
+            ("crdt_wal_appends_total",
+             "Commit records appended to the WAL", "appends"),
+            ("crdt_wal_appended_bytes_total",
+             "Bytes appended to the WAL", "appended_bytes"),
+            ("crdt_wal_fsyncs_total",
+             "WAL fsyncs (one may cover a whole group commit)",
+             "fsyncs"),
+            ("crdt_wal_truncations_total",
+             "WAL prefix truncations at spill/fold watermarks",
+             "truncations"),
+            ("crdt_wal_errors_total",
+             "WAL append/fsync failures (shed as 503)", "errors"),
+            ("crdt_wal_replay_records_total",
+             "Records replayed at the last recovery",
+             "replay_records"),
+            ("crdt_wal_torn_tail_dropped_total",
+             "Torn final records dropped at recovery",
+             "torn_dropped"),
+        )
+        for name, help_text, key in wal_counters:
+            w.family(name, "counter", help_text)
+            for d, t in wdocs:
+                w.sample(name, name, t[key], {"doc": d.doc_id})
+        w.family("crdt_wal_size_bytes", "gauge",
+                 "Current WAL file size (O(hot tail) steady-state)")
+        w.family("crdt_wal_epoch", "gauge",
+                 "Fencing epoch (bumped at every recovery-to-serving)")
+        for d, t in wdocs:
+            w.sample("crdt_wal_size_bytes", "crdt_wal_size_bytes",
+                     t["size_bytes"], {"doc": d.doc_id})
+            w.sample("crdt_wal_epoch", "crdt_wal_epoch", d.epoch,
+                     {"doc": d.doc_id})
+        w.family("crdt_wal_fsync_ms", "histogram",
+                 "WAL fsync latency (the durability tax per sync)")
+        for d, t in wdocs:
+            h = t["fsync_ms"]
+            if h is not None:
+                w.histogram("crdt_wal_fsync_ms",
+                            "WAL fsync latency (the durability tax "
+                            "per sync)",
+                            h["bounds"], h["counts"], h["count"],
+                            h["sum"], {"doc": d.doc_id})
+
     # -- engine-wide scheduler counters ----------------------------------
     for cname, val in sorted(engine.counters.snapshot().items()):
         safe = re.sub(r"[^a-zA-Z0-9_]", "_", cname)

@@ -60,6 +60,13 @@ FORWARDED_HEADER = "X-Fleet-Forwarded"
 # gates the cascade op-log's checkpoint advancement and segment GC;
 # oplog.py, cluster/gateway.py update_stability)
 AE_PEER_HEADER = "X-Ae-Peer"
+# rejoining-node catch-up (ISSUE 9): a fleet read of a document this
+# node doesn't hold yet — but a peer does — answers 503 + Retry-After
+# instead of 404, with this hint: the best local estimate of the ops
+# still to pull (peers-holding-the-doc count until the first window
+# lands; the priority pull it triggers usually lands within one
+# anti-entropy interval)
+CATCHUP_REMAINING_HEADER = "X-Catchup-Remaining"
 
 # accepted client-supplied ids: 8-64 url-safe chars (anything else is
 # re-minted — the id lands in filenames and label values)
@@ -104,7 +111,8 @@ class CommitTrace:
     __slots__ = ("doc_id", "trace_ids", "n_tickets", "num_ops",
                  "parse_ms", "queue_depth_admission", "stages_ms",
                  "chunk_count", "applied_ops", "dup_ops", "outcome",
-                 "staleness_s", "total_ms", "error", "packed")
+                 "staleness_s", "total_ms", "error", "packed",
+                 "wal_deferred")
 
     def __init__(self, doc_id: str, tickets) -> None:
         self.doc_id = doc_id
@@ -131,6 +139,10 @@ class CommitTrace:
         # the fused batch (NOT serialized): kept only so the sampled
         # chain audit can trace its shapes after the commit resolves
         self.packed = None
+        # True while this commit awaits the round's group fsync
+        # (serve/scheduler.py WAL batch mode): publish, ticket
+        # resolution, and the flight record all happen at the barrier
+        self.wal_deferred = False
 
     @contextlib.contextmanager
     def stage(self, name: str, span_name: Optional[str] = None):

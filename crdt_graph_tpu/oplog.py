@@ -77,6 +77,7 @@ from .codec import packed as packed_mod
 from .codec.packed import DEFAULT_MAX_DEPTH, KIND_ADD, PackedOps
 from .core.errors import CheckpointError
 from .core.operation import Add, Batch, Delete, Operation
+from .wal import maybe_crash as _maybe_crash
 
 EMPTY_BATCH_BYTES = b'{"op":"batch","ops":[]}'
 
@@ -164,6 +165,45 @@ class PackedBatch(Batch):
                 f"{', materialized' if self._ops is not None else ''})")
 
 
+class ViewSpanBatch(Batch):
+    """A ``Batch`` over a log-position span of a reference-stable
+    :class:`LogView`, materialized lazily — how ``restore_tiered``
+    rebuilds ``last_operation`` from the manifest's ``last_op_span``
+    without loading the cold segments the span lives in (a restore
+    must stay O(tail); the span may be a whole bootstrap ingest).
+    Consumers that only COUNT read :attr:`num_leaves`; touching
+    :attr:`ops` pays the segment load exactly once."""
+
+    def __init__(self, view: LogView, start: int, stop: int):
+        object.__setattr__(self, "_view", view)
+        object.__setattr__(self, "_start", start)
+        object.__setattr__(self, "_stop", stop)
+        object.__setattr__(self, "_ops", None)
+
+    @property
+    def num_leaves(self) -> int:
+        return self._stop - self._start
+
+    @property
+    def ops(self) -> tuple:
+        if self._ops is None:
+            object.__setattr__(self, "_ops", tuple(
+                self._view.materialize(self._start, self._stop)))
+        return self._ops
+
+    def __eq__(self, other):
+        if isinstance(other, Batch):
+            return self.ops == tuple(other.ops)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.ops,))
+
+    def __repr__(self):
+        return (f"ViewSpanBatch([{self._start}, {self._stop})"
+                f"{', materialized' if self._ops is not None else ''})")
+
+
 class _PackedSeg:
     """A row range of an in-memory PackedOps, as one hot segment."""
 
@@ -196,17 +236,23 @@ class TierConfig:
       (``GRAFT_OPLOG_CACHE_SEGS``).
     - ``ephemeral`` — delete segment files on :meth:`OpLog.close`
       (serving docs spill into a scratch dir; checkpoints don't).
+    - ``durable`` — crash-durable mode (docs/DURABILITY.md): segment
+      and base files are fsynced at seal, and every layout change
+      (spill, fold, tiered truncate) atomically rewrites
+      ``manifest.json`` so a restart can always reopen the tiers —
+      the WAL (wal.py) covers only the hot tail beyond them.
     """
 
     __slots__ = ("dir", "hot_ops", "hot_bytes", "gc_min_segs",
                  "auto_stable", "cache_segments", "ephemeral",
-                 "max_depth")
+                 "max_depth", "durable")
 
     def __init__(self, dir: str, hot_ops: int = 32768,
                  hot_bytes: int = 0, gc_min_segs: int = 4,
                  auto_stable: bool = True, cache_segments: int = 2,
                  ephemeral: bool = False,
-                 max_depth: int = DEFAULT_MAX_DEPTH):
+                 max_depth: int = DEFAULT_MAX_DEPTH,
+                 durable: bool = False):
         self.dir = dir
         self.hot_ops = max(1, int(hot_ops))
         self.hot_bytes = int(hot_bytes)
@@ -215,6 +261,7 @@ class TierConfig:
         self.cache_segments = max(1, int(cache_segments))
         self.ephemeral = ephemeral
         self.max_depth = max_depth
+        self.durable = durable
 
 
 class _SegCache:
@@ -320,15 +367,24 @@ class _ColdSeg:
     @staticmethod
     def seal(p: PackedOps, start: int, path: str,
              cache: Optional[_SegCache],
-             compress: bool = False) -> "_ColdSeg":
+             compress: bool = False,
+             fsync: bool = False) -> "_ColdSeg":
         """Write ``p``'s rows as one segment file and return its
         descriptor (add index built from the columns in hand — no
-        read-back)."""
+        read-back).  ``fsync``: durable mode — the file must be on
+        disk BEFORE the manifest references it (and before the WAL
+        prefix it replaces is truncated)."""
         from . import engine as engine_mod
         n = p.num_ops
         meta = {"num_ops": n, "hints_vouched": bool(p.hints_vouched),
                 "start": start, "kind": "oplog-segment"}
         engine_mod.write_packed_npz(path, p, meta, compress=compress)
+        if fsync:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         add_ts, add_pos = _ColdSeg._add_index(p.kind[:n], p.ts[:n])
         return _ColdSeg(path, start, n, add_ts, add_pos,
                         os.path.getsize(path), cache,
@@ -684,8 +740,15 @@ class OpLog:
         self._cache: Optional[_SegCache] = None
         self._stable: Optional[int] = None
         self._on_spill: Optional[Callable[[], None]] = None
+        # durable mode (docs/DURABILITY.md): meta_cb supplies the
+        # manifest's clock/cursor meta at write time; on_advance is
+        # told the new tiered extent after every manifest write so
+        # the owner can truncate the WAL prefix the tiers now cover
+        self._meta_cb: Optional[Callable[[], dict]] = None
+        self._on_advance: Optional[Callable[[int], None]] = None
         self._views: "weakref.WeakSet[LogView]" = weakref.WeakSet()
         self._tombs: List[_ColdSeg] = []
+        self._advance_pending: Optional[int] = None
         self._file_seq = 0
         self._base_gen = 0
         # telemetry counters (crdt_oplog_* prom families)
@@ -705,12 +768,16 @@ class OpLog:
                        cache_segments: int = 2,
                        ephemeral: bool = False,
                        max_depth: int = DEFAULT_MAX_DEPTH,
-                       on_spill: Optional[Callable[[], None]] = None
+                       on_spill: Optional[Callable[[], None]] = None,
+                       durable: bool = False
                        ) -> "OpLog":
         """Arm the cascade: ops past the hot budget spill to packed-npz
         files under ``dir`` at the next :meth:`maybe_spill`.
         ``on_spill`` lets the owning tree drop its full-packing cache
-        when resident columns move to disk."""
+        when resident columns move to disk.  ``durable`` arms
+        crash-durable manifests (TierConfig docstring); wire the
+        manifest meta + WAL-truncate callbacks via
+        :meth:`set_durable_hooks`."""
         with self._mu:
             os.makedirs(dir, exist_ok=True)
             self._cfg = TierConfig(dir, hot_ops=hot_ops,
@@ -719,7 +786,8 @@ class OpLog:
                                    auto_stable=auto_stable,
                                    cache_segments=cache_segments,
                                    ephemeral=ephemeral,
-                                   max_depth=max_depth)
+                                   max_depth=max_depth,
+                                   durable=durable)
             if self._cache is None:
                 self._cache = _SegCache(self._cfg.cache_segments)
             if on_spill is not None:
@@ -754,6 +822,13 @@ class OpLog:
     def stable_mark(self) -> int:
         with self._mu:
             return self._stable_locked()
+
+    @property
+    def tiered_extent(self) -> int:
+        """Ops durable in cold segments + base (what the manifest
+        covers; the WAL-truncation watermark)."""
+        with self._mu:
+            return self._tiered_len
 
     def _stable_locked(self) -> int:
         if self._cfg is not None and self._cfg.auto_stable:
@@ -841,11 +916,16 @@ class OpLog:
                 self._truncate_hot_locked(n - self._tiered_len)
             else:
                 self._truncate_tiered_locked(n)
+                # durable mode: the tier layout changed — the manifest
+                # must stop referencing the cut segments before a
+                # restart could reopen them
+                self._durable_manifest_locked()
             self._len = n
             if self._last_add is not None and self._last_add >= n:
                 self._recompute_last_add_locked()
             if self._stable is not None:
                 self._stable = min(self._stable, n)
+        self._fire_advance()
 
     def _truncate_hot_locked(self, keep_hot: int) -> None:
         base = 0
@@ -948,6 +1028,7 @@ class OpLog:
                 self._stable = self._len
             self._gc_locked()
             self._sweep_tombs_locked()
+        self._fire_advance()
         if spilled and self._on_spill is not None:
             try:
                 self._on_spill()
@@ -957,6 +1038,82 @@ class OpLog:
 
     def set_on_spill(self, cb: Optional[Callable[[], None]]) -> None:
         self._on_spill = cb
+
+    def set_durable_hooks(self, meta_cb: Optional[Callable[[], dict]],
+                          on_advance: Optional[Callable[[int], None]]
+                          ) -> None:
+        """Durable mode wiring (serve/engine.py ``ServedDoc``):
+        ``meta_cb()`` supplies the clock/cursor meta stamped into each
+        manifest write; ``on_advance(tiered_len)`` fires after a
+        manifest made rows below ``tiered_len`` durable in the tiers —
+        the owner truncates the WAL prefix they cover."""
+        with self._mu:
+            self._meta_cb = meta_cb
+            self._on_advance = on_advance
+
+    def _write_manifest_locked(self, target: str, length: int,
+                               meta: dict) -> str:
+        """Atomically (re)write ``manifest.json`` describing the
+        current tier layout.  Durable mode fsyncs the tmp before the
+        rename so a crash leaves either the old or the new manifest,
+        never a torn one (the ``mid-manifest-write`` kill site sits
+        between the two, proving exactly that)."""
+        import json
+        manifest = {
+            "version": 1,
+            "length": length,
+            "base": ({"file": os.path.basename(self._base.path),
+                      "len": self._base.length}
+                     if self._base is not None else None),
+            "segments": [{"file": os.path.basename(cs.path),
+                          "start": cs.start, "len": cs.length}
+                         for cs in self._cold],
+            "meta": meta,
+        }
+        path = os.path.join(target, "manifest.json")
+        tmp = path + ".tmp"
+        durable = self._cfg is not None and self._cfg.durable
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        _maybe_crash("mid-manifest-write")
+        os.replace(tmp, path)
+        if durable:
+            # directory fsync: the renamed manifest AND the freshly
+            # sealed segment entries it references must survive a
+            # POWER loss, not just a process kill
+            from .wal import _fsync_dir
+            _fsync_dir(target)
+        return path
+
+    def _durable_manifest_locked(self) -> None:
+        """Durable layout change: persist the manifest (tiers only —
+        the WAL covers the hot tail) and remember the new tiered
+        extent for the post-lock ``on_advance`` callback."""
+        cfg = self._cfg
+        if cfg is None or not cfg.durable:
+            return
+        meta = {}
+        if self._meta_cb is not None:
+            try:
+                meta = self._meta_cb()
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                meta = {}
+        self._write_manifest_locked(cfg.dir, self._tiered_len, meta)
+        self._advance_pending = self._tiered_len
+
+    def _fire_advance(self) -> None:
+        """Run the deferred ``on_advance`` callback outside the tier
+        lock (it truncates the WAL — file I/O under its own lock)."""
+        adv = getattr(self, "_advance_pending", None)
+        self._advance_pending = None
+        if adv is not None and self._on_advance is not None:
+            try:
+                self._on_advance(adv)
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                pass
 
     def _spill_locked(self, k: int) -> None:
         """Seal the first ``k`` hot ops into ``~hot_ops``-sized cold
@@ -1019,10 +1176,17 @@ class OpLog:
                 cfg.dir, f"seg-{start:012d}-{e - s}-"
                          f"{self._file_seq}.npz")
             self._cold.append(
-                _ColdSeg.seal(piece, start, path, self._cache))
+                _ColdSeg.seal(piece, start, path, self._cache,
+                              fsync=cfg.durable))
             self._tiered_len += e - s
             self._hot_len -= e - s
             self.spills += 1
+            # chaos site: segment file(s) sealed, manifest NOT yet
+            # written — recovery must reopen the OLD manifest and
+            # replay the untruncated WAL over it (the stray files are
+            # unreferenced and harmlessly overwritten later)
+            _maybe_crash("mid-spill")
+        self._durable_manifest_locked()
 
     def run_gc(self) -> None:
         """Checkpoint advancement + segment GC, gated by the stability
@@ -1031,6 +1195,7 @@ class OpLog:
         with self._mu:
             self._gc_locked()
             self._sweep_tombs_locked()
+        self._fire_advance()
 
     def _gc_locked(self) -> None:
         cfg = self._cfg
@@ -1061,7 +1226,13 @@ class OpLog:
         path = os.path.join(
             cfg.dir, f"base-{merged.num_ops:012d}-"
                      f"g{self._base_gen}.npz")
-        new_base = _ColdSeg.seal(merged, 0, path, self._cache)
+        new_base = _ColdSeg.seal(merged, 0, path, self._cache,
+                                 fsync=cfg.durable)
+        # chaos site: the folded base exists on disk but the manifest
+        # still references the old base + segments — which are only
+        # deleted AFTER the manifest write below, so recovery from the
+        # old manifest always finds its files
+        _maybe_crash("mid-fold")
         if self._base is not None:
             self._tombs.append(self._base)
         self._tombs.extend(fold)
@@ -1069,6 +1240,7 @@ class OpLog:
         del self._cold[:len(fold)]
         self.compactions += 1
         self.segments_gc += len(fold)
+        self._durable_manifest_locked()
 
     def _sweep_tombs_locked(self) -> None:
         """Delete folded/replaced segment files whose descriptors no
@@ -1241,24 +1413,7 @@ class OpLog:
                 for cs in segs:
                     shutil.copyfile(cs.path, os.path.join(
                         target, os.path.basename(cs.path)))
-            manifest = {
-                "version": 1,
-                "length": self._len,
-                "base": ({"file": os.path.basename(self._base.path),
-                          "len": self._base.length}
-                         if self._base is not None else None),
-                "segments": [{"file": os.path.basename(cs.path),
-                              "start": cs.start, "len": cs.length}
-                             for cs in self._cold],
-                "meta": meta,
-            }
-            import json
-            path = os.path.join(target, "manifest.json")
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            os.replace(tmp, path)
-            return path
+            return self._write_manifest_locked(target, self._len, meta)
 
     @classmethod
     def open_dir(cls, dir: str, **tier_kw) -> Tuple["OpLog", dict]:
@@ -1313,6 +1468,19 @@ class OpLog:
             log._recompute_last_add_locked()
             if log._cfg.auto_stable:
                 log._stable = running
+            # resume file numbering past anything on disk — including
+            # stray files a crash left sealed but unreferenced (a new
+            # seal must never clobber a manifest-referenced file, and
+            # overwriting strays silently is fine only because names
+            # never collide with live descriptors)
+            import re as _re
+            for fn in os.listdir(dir):
+                m = _re.match(r"seg-\d+-\d+-(\d+)\.npz$", fn)
+                if m:
+                    log._file_seq = max(log._file_seq, int(m.group(1)))
+                m = _re.match(r"base-\d+-g(\d+)\.npz$", fn)
+                if m:
+                    log._base_gen = max(log._base_gen, int(m.group(1)))
         return log, manifest.get("meta", {})
 
     # -- telemetry ---------------------------------------------------------

@@ -5,10 +5,11 @@ The layer between the HTTP handlers (service/http.py) and the TPU engine
 backpressure contracts.
 """
 from .engine import (ECHO_LIMIT, ServedDoc, ServingEngine)
-from .queue import QueueFull, SchedulerError, SchedulerStopped
+from .queue import (QueueFull, SchedulerError, SchedulerStopped,
+                    WalUnavailable)
 from .scheduler import MergeScheduler
 from .snapshot import DocSnapshot
 
 __all__ = ["ECHO_LIMIT", "DocSnapshot", "MergeScheduler", "QueueFull",
            "SchedulerError", "SchedulerStopped", "ServedDoc",
-           "ServingEngine"]
+           "ServingEngine", "WalUnavailable"]

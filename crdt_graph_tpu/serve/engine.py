@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import engine as engine_mod
+from .. import wal as wal_mod
 from ..codec import json_codec
 from ..codec import packed as packed_mod
 from ..core import operation as op_mod
@@ -85,24 +86,48 @@ class ServedDoc:
                  max_depth: int):
         self.doc_id = doc_id
         self._engine = engine
-        self.tree = engine_mod.init(SERVER_REPLICA, max_depth=max_depth)
-        if engine.oplog_hot_ops > 0:
-            # cascade tiering (oplog.py): hot tail in memory, sealed
-            # cold segments on scratch disk, watermark-gated GC.  A
-            # fleet node (cluster/gateway.py) turns auto-stability off
-            # and feeds explicit anti-entropy watermarks instead.
-            # The subdir is PREFIXED: the wire route's doc-id charset
-            # ([A-Za-z0-9_.-]) admits "." and ".." verbatim, which as
-            # bare path components would alias (or escape) the
-            # engine-owned spill root; "doc-.." is just a filename.
-            self.tree.enable_log_tiering(
-                os.path.join(engine.oplog_dir, f"doc-{doc_id}"),
-                hot_ops=engine.oplog_hot_ops,
-                hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
-                gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
-                auto_stable=not engine.external_stability,
-                cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
-                ephemeral=True)
+        # crash durability (wal.py; docs/DURABILITY.md): with a
+        # durable_dir the document's tiers live in a persistent per-doc
+        # subdir, every tier-layout change rewrites the manifest, and a
+        # WAL under the cascade makes acked hot-tail ops survive a
+        # kill; wal/epoch stay None/0 on the default ephemeral path
+        self.wal: Optional[wal_mod.Wal] = None
+        self.epoch = 0
+        self.recovered = False
+        self.replay_stats: Optional[Dict] = None
+        # deferred WAL truncation: spills/folds note the new tiered
+        # extent here, and the prefix is dropped only at the next
+        # successful fsync (wal_mark_durable) — truncating at spill
+        # time could drop records covering rows an in-flight commit's
+        # WAL-shed rollback may reload out of a straddling segment
+        self._wal_truncate_pending = False
+        # pre-commit state for the WAL shed rollback (scheduler
+        # thread only; one commit per doc per round)
+        self._commit_saved: Optional[tuple] = None
+        if engine.durable_dir is not None:
+            self._init_durable(engine, max_depth)
+        else:
+            self.tree = engine_mod.init(SERVER_REPLICA,
+                                        max_depth=max_depth)
+            if engine.oplog_hot_ops > 0:
+                # cascade tiering (oplog.py): hot tail in memory,
+                # sealed cold segments on scratch disk, watermark-gated
+                # GC.  A fleet node (cluster/gateway.py) turns
+                # auto-stability off and feeds explicit anti-entropy
+                # watermarks instead.
+                # The subdir is PREFIXED: the wire route's doc-id
+                # charset ([A-Za-z0-9_.-]) admits "." and ".." verbatim,
+                # which as bare path components would alias (or escape)
+                # the engine-owned spill root; "doc-.." is just a
+                # filename.
+                self.tree.enable_log_tiering(
+                    os.path.join(engine.oplog_dir, f"doc-{doc_id}"),
+                    hot_ops=engine.oplog_hot_ops,
+                    hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
+                    gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
+                    auto_stable=not engine.external_stability,
+                    cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
+                    ephemeral=True)
         self.queue = DocQueue(max_requests=engine.max_queue_requests,
                               max_leaves=engine.max_queue_leaves)
         self.next_replica = 1
@@ -119,6 +144,68 @@ class ServedDoc:
         self._seq = 0
         self._snap = snapshot_mod.derive(doc_id, 0, self.tree)
         self._prev_snap: Optional[snapshot_mod.DocSnapshot] = None
+
+    def _init_durable(self, engine: "ServingEngine",
+                      max_depth: int) -> None:
+        """Open (or recover) this document's durable state: tiers from
+        the manifest when one exists, then WAL tail replay through the
+        ordinary apply path, then a bumped fencing epoch — the
+        recovered document is serving-ready the moment construction
+        returns (the first snapshot derives below, exactly like a
+        fresh doc; a non-empty replay pays the one first-merge
+        materialization a restored doc owes anyway)."""
+        ddir = os.path.join(engine.durable_dir, f"doc-{self.doc_id}")
+        os.makedirs(ddir, exist_ok=True)
+        manifest = os.path.join(ddir, "manifest.json")
+        had_manifest = os.path.exists(manifest)
+        tier_kw = dict(
+            hot_ops=max(1, engine.oplog_hot_ops),
+            hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
+            gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
+            auto_stable=not engine.external_stability,
+            cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
+            ephemeral=False, durable=True)
+        if had_manifest:
+            self.tree = engine_mod.TpuTree.restore_tiered(
+                ddir, **tier_kw)
+        else:
+            self.tree = engine_mod.init(SERVER_REPLICA,
+                                        max_depth=max_depth)
+            if engine.oplog_hot_ops > 0:
+                self.tree.enable_log_tiering(ddir, **tier_kw)
+        if self.tree._log.tiering_enabled:
+            self.tree._log.set_durable_hooks(
+                self.tree.manifest_meta, self._on_tier_advance)
+        if engine.wal_sync != "off":
+            self.wal = wal_mod.Wal(os.path.join(ddir, "wal.log"))
+            # raises typed WalError on mid-log corruption — a server
+            # must never silently serve a partially replayed log
+            self.replay_stats = self.wal.replay_into(
+                self.tree, engine.chunk_ops)
+            # replay-time spills noted truncations; nothing is in
+            # flight, so fold them into the file now
+            self.wal_mark_durable()
+        self.recovered = had_manifest or bool(
+            (self.replay_stats or {}).get("records"))
+        self.epoch = wal_mod.bump_epoch(ddir)
+
+    def _on_tier_advance(self, tiered_len: int) -> None:
+        """Spill/fold manifest landed: rows below ``tiered_len`` are
+        durable in cold segments.  The WAL prefix they cover is
+        dropped at the NEXT successful fsync (:meth:`wal_mark_durable`
+        — steady-state WAL size stays O(hot tail)); truncating here
+        could strand a WAL-shed rollback that reloads hot rows out of
+        a straddling segment the spill just sealed."""
+        self._wal_truncate_pending = True
+
+    def wal_mark_durable(self) -> None:
+        """Everything in the log is now fsync-durable (tiers ∪ synced
+        WAL) and no rollback is possible — safe to drop the WAL prefix
+        the tiers cover.  Called by the scheduler after each
+        successful fsync, and once after recovery replay."""
+        if self.wal is not None and self._wal_truncate_pending:
+            self._wal_truncate_pending = False
+            self.wal.truncate_below(self.tree._log.tiered_extent)
 
     # -- snapshot publication (scheduler thread only) ---------------------
 
@@ -223,6 +310,11 @@ class ServedDoc:
             "coalesce_width": self.coalesce_width.snapshot(),
             # cascade op-log tier state (oplog.py; docs/OPLOG.md)
             "oplog": self.tree._log.telemetry(),
+            # crash durability (wal.py; docs/DURABILITY.md)
+            "durable": self._engine.durable_dir is not None,
+            "epoch": self.epoch,
+            "recovered": self.recovered,
+            "wal": None if self.wal is None else self.wal.telemetry(),
         }
 
 
@@ -241,6 +333,8 @@ class ServingEngine:
                  submit_timeout_s: float = 600.0,
                  oplog_hot_ops: Optional[int] = None,
                  oplog_dir: Optional[str] = None,
+                 durable_dir: Optional[str] = None,
+                 wal_sync: Optional[str] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
                  start: bool = True):
@@ -253,9 +347,23 @@ class ServingEngine:
         # removed with the engine when it was auto-created.
         self.oplog_hot_ops = oplog_hot_ops if oplog_hot_ops is not None \
             else _env_int("GRAFT_OPLOG_HOT_OPS", DEFAULT_OPLOG_HOT_OPS)
+        # crash durability (wal.py; docs/DURABILITY.md): a durable_dir
+        # puts every document's tiers + WAL in a persistent per-doc
+        # subdir; acked writes then survive a kill (fsync-before-ack,
+        # GRAFT_WAL_SYNC=commit|batch; "off" keeps the durable tier
+        # dirs but no WAL — the bench baseline).  Pre-existing doc
+        # dirs under it are recovered to serving at construction.
+        self.durable_dir = durable_dir \
+            or os.environ.get("GRAFT_DURABLE_DIR") or None
+        self.wal_sync = wal_sync if wal_sync is not None \
+            else wal_mod.sync_mode_from_env()
+        if self.wal_sync not in wal_mod.SYNC_MODES:
+            raise ValueError(f"wal_sync {self.wal_sync!r} not in "
+                             f"{wal_mod.SYNC_MODES}")
         self._own_oplog_dir = False
         self.oplog_dir = oplog_dir or os.environ.get("GRAFT_OPLOG_DIR")
-        if self.oplog_hot_ops > 0 and self.oplog_dir is None:
+        if self.oplog_hot_ops > 0 and self.oplog_dir is None \
+                and self.durable_dir is None:
             import tempfile
             self.oplog_dir = tempfile.mkdtemp(prefix="graft-oplog-")
             self._own_oplog_dir = True
@@ -284,6 +392,17 @@ class ServingEngine:
         # the crdt_oracle_* prom families when present
         self.oracle: Optional[oracle_mod.SessionOracle] = None
         self.scheduler = MergeScheduler(self)
+        # recovery-to-serving: reopen every durable document found on
+        # disk NOW, so a restarted server answers reads (and accepts
+        # writes at its bumped epoch) immediately instead of 404ing
+        # until first access.  Raises typed WalError/CheckpointError
+        # on real corruption — never a silent partial recovery.
+        if self.durable_dir is not None:
+            os.makedirs(self.durable_dir, exist_ok=True)
+            for name in sorted(os.listdir(self.durable_dir)):
+                if name.startswith("doc-") and os.path.isdir(
+                        os.path.join(self.durable_dir, name)):
+                    self.get(name[len("doc-"):])
         if start:
             self.scheduler.start()
 
@@ -501,6 +620,8 @@ class ServingEngine:
                 d.tree._log.close()
             except Exception:   # noqa: BLE001 — shutdown boundary
                 pass
+            if d.wal is not None:
+                d.wal.close()
         if self._own_oplog_dir:
             import shutil
             shutil.rmtree(self.oplog_dir, ignore_errors=True)

@@ -45,6 +45,18 @@ class SchedulerStopped(Exception):
     deadline); the request was not merged."""
 
 
+class WalUnavailable(SchedulerStopped):
+    """The write-ahead log could not accept (or fsync) this commit's
+    record — disk full, EIO.  Durability cannot be promised, so the
+    ack is withheld and the HTTP layer answers an honest 503 (the
+    SchedulerStopped mapping): the server keeps serving reads and
+    sheds writes until the disk recovers, instead of crashing or —
+    worse — acking into a log that lost the bytes.  The merge is
+    ROLLED BACK (scheduler ``_wal_shed``) so the log never holds ops
+    that live in neither the tiers nor the WAL; the client's retry
+    applies for real once the disk recovers."""
+
+
 class SchedulerError(Exception):
     """A non-CRDT failure while the scheduler processed this request's
     round (kernel launch failure, allocation failure, a bug).  Wraps

@@ -43,11 +43,13 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .. import wal as wal_mod
 from ..codec import packed as packed_mod
 from ..core.errors import CRDTError
 from ..obs.trace import CommitTrace
 from ..utils import profiling
-from .queue import SchedulerError, SchedulerStopped, WriteTicket
+from .queue import (SchedulerError, SchedulerStopped, WalUnavailable,
+                    WriteTicket)
 
 # one work item: (doc, tickets, fused_batch_or_None, ticket_row_spans,
 # commit_trace) — the CommitTrace collects the per-stage breakdown and
@@ -73,6 +75,12 @@ class MergeScheduler(threading.Thread):
         # the round's last flight record
         self._busy = False
         self._rounds_completed = 0
+        # group commit (wal.py; docs/DURABILITY.md): commits whose WAL
+        # records were appended but not yet fsynced this round —
+        # publish, ticket resolution, and the flight record wait for
+        # the round barrier's single fsync per document.  Scheduler
+        # thread only.
+        self._wal_round: List[tuple] = []
 
     # -- lifecycle --------------------------------------------------------
 
@@ -104,6 +112,16 @@ class MergeScheduler(threading.Thread):
     # -- main loop --------------------------------------------------------
 
     def run(self) -> None:
+        try:
+            self._run()
+        except wal_mod.CrashPoint:
+            # simulated kill (GRAFT_CRASH_POINT, in-process mode):
+            # die exactly like a SIGKILL would — resolve nothing,
+            # fail nothing, clean up nothing.  The chaos harness
+            # abandons this engine and recovers from disk.
+            return
+
+    def _run(self) -> None:
         while True:
             with self.cond:
                 while not self._stop_requested and \
@@ -241,6 +259,7 @@ class MergeScheduler(threading.Thread):
         return work
 
     def _process(self, work: List[_WorkItem]) -> None:
+        self._wal_round = []
         singles: List[_WorkItem] = []
         groups: dict = {}
         for item in work:
@@ -276,6 +295,7 @@ class MergeScheduler(threading.Thread):
             self._guarded(self._commit_single, item)
         for items in grouped_runs:
             self._process_grouped(items)
+        self._finish_wal_round()
 
     def _guarded(self, fn, item: _WorkItem, *args) -> None:
         """Run one document's commit; a non-CRDT failure is recorded on
@@ -313,13 +333,18 @@ class MergeScheduler(threading.Thread):
         total_ms = (time.perf_counter() - t0) * 1e3 \
             + ct.stages_ms.get("batch_prepare", 0.0) \
             + ct.stages_ms.get("batched_launch", 0.0)
-        doc.commit_ms.observe(total_ms)
         ct.total_ms = total_ms
+        if ct.wal_deferred:
+            # group commit: the round barrier fsyncs, publishes,
+            # resolves, and records — the total keeps accruing there
+            return
+        doc.commit_ms.observe(total_ms)
         self.engine.record_commit(doc, ct)
 
     def _commit_single(self, item: _WorkItem) -> None:
         doc, tickets, fused, spans, ct = item
         n = fused.num_ops
+        doc._commit_saved = doc.tree.begin_commit()
         try:
             with ct.stage("merge"):
                 doc.tree.apply_packed_chunked(fused, self.engine.chunk_ops)
@@ -358,6 +383,12 @@ class MergeScheduler(threading.Thread):
                 self.engine.finish_ticket(doc, t, mask)
                 ct.applied_ops += int(mask.sum())
                 any_applied = any_applied or bool(mask.any())
+                # durable ack: each applied ticket's ops become one
+                # WAL record (end_pos = the log right after ITS apply)
+                if doc.wal is not None and mask.any() and \
+                        not self._wal_append(doc, tickets, ct,
+                                             t.packed, mask):
+                    return
         ct.dup_ops = sum(t.n_leaves for t in tickets
                          if t.accepted) - ct.applied_ops
         if not any_rejected:
@@ -368,6 +399,13 @@ class MergeScheduler(threading.Thread):
             ct.outcome = "partial"
         else:
             ct.outcome = "rejected"
+        if doc.wal is not None and any_applied:
+            if self.engine.wal_sync == "batch":
+                ct.wal_deferred = True
+                self._wal_round.append((doc, tickets, ct, True))
+                return
+            if not self._wal_sync_now(doc, tickets, ct):
+                return
         if any_applied:
             with ct.stage("publish"):
                 ct.staleness_s = doc.publish()
@@ -395,11 +433,128 @@ class MergeScheduler(threading.Thread):
             for t in tickets:
                 t.done.set()
             return
+        if doc.wal is not None and mask.any():
+            # durable ack: the commit's applied rows hit the WAL (and
+            # disk) BEFORE the snapshot publishes or any ticket
+            # resolves — the crash window between merge and fsync
+            # loses only un-acked work
+            if not self._wal_append(doc, tickets, ct, ct.packed, mask):
+                return
+            if self.engine.wal_sync == "batch":
+                # group commit: fsync once per doc at the round
+                # barrier; publish + ack wait for it
+                ct.wal_deferred = True
+                self._wal_round.append((doc, tickets, ct, True))
+                return
+            if not self._wal_sync_now(doc, tickets, ct):
+                return
         if mask.any():
             with ct.stage("publish"):
                 ct.staleness_s = doc.publish()
         for t in tickets:
             t.done.set()
+
+    # -- write-ahead log (wal.py; docs/DURABILITY.md) ----------------------
+
+    def _wal_append(self, doc, tickets: List[WriteTicket],
+                    ct: CommitTrace, packed, mask: np.ndarray) -> bool:
+        """Append the applied rows of one commit (or one sequential
+        ticket) to the document's WAL.  False = the disk refused:
+        every unresolved ticket was shed with an honest 503
+        (:class:`WalUnavailable`) and the commit records as an
+        error — the scheduler survives, the server keeps serving."""
+        applied = int(mask.sum())
+        sel = packed if applied == packed.num_ops else \
+            packed_mod.select_rows(packed, np.nonzero(mask)[0])
+        try:
+            with ct.stage("wal_append"):
+                doc.wal.append(sel, doc.tree.log_length)
+        except OSError as e:
+            self._wal_shed(doc, tickets, ct, e)
+            return False
+        return True
+
+    def _wal_sync_now(self, doc, tickets: List[WriteTicket],
+                      ct: CommitTrace) -> bool:
+        """``GRAFT_WAL_SYNC=commit``: fsync this commit's record(s)
+        inline, between the two ack-boundary kill sites."""
+        wal_mod.maybe_crash("ack-pre-fsync")
+        try:
+            with ct.stage("wal_fsync"):
+                doc.wal.sync()
+        except OSError as e:
+            self._wal_shed(doc, tickets, ct, e)
+            return False
+        wal_mod.maybe_crash("post-fsync-pre-publish")
+        doc.wal_mark_durable()
+        return True
+
+    def _wal_shed(self, doc, tickets: List[WriteTicket],
+                  ct: CommitTrace, e: Exception) -> None:
+        """Durability refused (ENOSPC/EIO): withhold the acks AND roll
+        the merge back, so the log never holds ops that live in
+        neither the tiers nor the WAL (a later acked write could
+        causally depend on them — a disk hiccup must not become acked
+        loss at the next crash).  The client retries; once the disk
+        recovers the replayed delta applies for real."""
+        self.engine.counters.add("wal_shed_commits")
+        if doc._commit_saved is not None:
+            try:
+                doc.tree.rollback_commit(doc._commit_saved)
+            except Exception:   # noqa: BLE001 — rollback is best-
+                # effort containment; failing it leaves merged
+                # un-acked ops (the pre-rollback semantics), counted
+                self.engine.counters.add("wal_rollback_errors")
+            doc._commit_saved = None
+        err = WalUnavailable(
+            f"write-ahead log unavailable for {doc.doc_id!r}: {e!r}")
+        err.__cause__ = e
+        for t in tickets:
+            if not t.done.is_set():
+                t.error = err
+                t.done.set()
+        ct.outcome = "error"
+        ct.error = f"wal: {e!r}"
+        ct.wal_deferred = False
+
+    def _finish_wal_round(self) -> None:
+        """The group-commit barrier: every commit the round merged
+        gets its fsync AFTER all the round's compute (merges never
+        interleave with fsync waits), and ONE fsync per document
+        covers every ticket coalesced into its commit.  Each document
+        resolves right after its OWN fsync — a round touching many
+        documents must not couple their fsync latencies into every
+        ack (fsyncs are per-doc files; a cross-doc barrier would add
+        latency without saving a single call).  fsync latency is
+        billed into each commit's ``wal_fsync`` stage (the flight
+        recorder's view of the durability tax)."""
+        pending, self._wal_round = self._wal_round, []
+        for doc, tickets, ct, publish_needed in pending:
+            wal_mod.maybe_crash("ack-pre-fsync")
+            t0 = time.perf_counter()
+            try:
+                doc.wal.sync()
+            except OSError as e:
+                self._wal_shed(doc, tickets, ct, e)
+                self.engine.record_commit(doc, ct)
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            wal_mod.maybe_crash("post-fsync-pre-publish")
+            doc.wal_mark_durable()
+            ct.stages_ms["wal_fsync"] = round(
+                ct.stages_ms.get("wal_fsync", 0.0) + ms, 3)
+            t0 = time.perf_counter()
+            if publish_needed:
+                with ct.stage("publish"):
+                    ct.staleness_s = doc.publish()
+            for t in tickets:
+                t.done.set()
+            ct.wal_deferred = False
+            ct.total_ms = round(
+                ct.total_ms + ms
+                + (time.perf_counter() - t0) * 1e3, 3)
+            doc.commit_ms.observe(ct.total_ms)
+            self.engine.record_commit(doc, ct)
 
     # -- cross-document batched launch ------------------------------------
 
@@ -469,6 +624,7 @@ class MergeScheduler(threading.Thread):
         doc, tickets, fused, spans, ct = item
         doc.chunks_launched += 1
         ct.chunk_count = 1
+        doc._commit_saved = doc.tree.begin_commit()
         try:
             with ct.stage("merge"):
                 doc.tree.finish_packed(fused, p, table)

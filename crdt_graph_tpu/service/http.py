@@ -76,7 +76,8 @@ from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
 from ..obs import prom as prom_mod
-from ..obs.trace import (AE_PEER_HEADER, COMMIT_SEQ_HEADER,
+from ..obs.trace import (AE_PEER_HEADER, CATCHUP_REMAINING_HEADER,
+                         COMMIT_SEQ_HEADER,
                          FORWARDED_HEADER, SESSION_HEADER,
                          SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
                          SINCE_NEXT_HEADER, SNAP_FP_HEADER,
@@ -191,6 +192,23 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 return
             doc = store.get(doc_id, create=False)
             if doc is None:
+                # rejoining-node catch-up (cluster/gateway.py): when a
+                # fleet peer HAS this document, this node is merely
+                # behind (restart / fresh ring ownership) — answer an
+                # honest 503 + Retry-After with a catch-up hint and
+                # trigger a priority anti-entropy pull, instead of the
+                # long 404 window the background sync left before
+                cs = store.catchup_status(doc_id) \
+                    if hasattr(store, "catchup_status") else None
+                if cs is not None:
+                    self._send(
+                        503, {"error": f"document {doc_id} is being "
+                                       "caught up from the fleet",
+                              "retry_after_s": cs["retry_after_s"]},
+                        headers={"Retry-After": str(cs["retry_after_s"]),
+                                 CATCHUP_REMAINING_HEADER:
+                                     str(cs["remaining"])})
+                    return
                 self._send(404, {"error": f"no document {doc_id}"})
                 return
             if sub == "":

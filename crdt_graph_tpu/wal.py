@@ -1,0 +1,485 @@
+"""Per-document write-ahead log: crash-durable acked writes.
+
+The cascade op-log (oplog.py) bounds *resident* memory, but until a
+spill fires every acked write lives only in the in-memory hot tail — a
+``SIGKILL`` between ack and spill silently lost up to
+``GRAFT_OPLOG_HOT_OPS`` acknowledged operations per document.  This
+module closes that window: the serving scheduler appends every commit's
+applied ops to the document's WAL and fsyncs **before the ack is
+released** (serve/scheduler.py), so the durable-ack contract holds at
+every kill point:
+
+- **record format** — an 8-byte file magic, then length-prefixed
+  checksummed records: ``u32 payload_len | u32 crc32(payload)`` followed
+  by the payload, which is an 8-byte big-endian ``end_pos`` (the
+  document's log length right after the commit — the truncation
+  watermark) and the commit's applied ops as one uncompressed
+  packed-npz blob (``engine.write_packed_npz`` — the same column format
+  the cascade's cold segments use, so WAL replay and segment loads
+  share one codec).
+- **group commit** — ``GRAFT_WAL_SYNC=batch`` (the default when a WAL
+  is armed): appends buffer through the scheduler round's compute,
+  then one fsync per document covers every ticket coalesced into its
+  commit, and the document's tickets resolve right after its own
+  fsync (per-doc files make a cross-doc barrier pure added latency).
+  ``commit`` fsyncs inline per commit; ``off`` disables the WAL
+  entirely (the durability-tax baseline
+  ``scripts/bench_wal_headline.py`` measures against).
+- **replay taxonomy** (:func:`scan`) — a torn FINAL record (truncated
+  header, truncated payload, or a checksum mismatch ending exactly at
+  EOF: the shapes a crash mid-append leaves behind) is tolerated,
+  counted, and truncated away; a checksum mismatch **mid-log** (valid
+  bytes continue past the bad record) is real corruption and raises a
+  typed :class:`WalError` — never a silent partial replay.
+- **truncation** — spill/fold watermarks drop records whose
+  ``end_pos`` is at or below the tiered extent (those ops are durable
+  in cold segments + manifest), so steady-state WAL size is O(hot
+  tail).  Truncation is atomic (tmp + fsync + rename); a crash
+  mid-truncate leaves either file, and duplicate replay absorbs
+  through the engine's apply dedup.
+
+Recovery (serve/engine.py ``ServedDoc``): ``restore_tiered`` opens the
+durable manifest's checkpoint base + cold segments, then
+:func:`replay_into` re-applies the WAL tail through the ordinary apply
+path — records fully below the restored extent are skipped, straddling
+ones absorb as duplicates — and the recovered document is
+serving-ready immediately with its fencing epoch bumped
+(:func:`bump_epoch`).  Windows served off the recovered log stay
+byte-identical to the untiered ``packed_since_window`` contract
+(pinned by tests/test_wal.py).
+
+Crash-point chaos (:func:`maybe_crash`): ``GRAFT_CRASH_POINT=<site>``
+arms a deterministic in-process kill at one of the durability
+boundaries (``ack-pre-fsync``, ``post-fsync-pre-publish``,
+``mid-spill``, ``mid-fold``, ``mid-manifest-write``).  With
+``GRAFT_CRASH_EXIT=1`` the process dies hard (``os._exit(137)`` — the
+subprocess matrix and the SIGKILL fleet soak); without it a
+:class:`CrashPoint` is raised, which the tier-1 harness uses to model
+a crash in-process: everything already ``write()``-en survives in the
+page cache exactly as it would a process kill, and the test abandons
+the wounded engine and recovers from disk.
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+MAGIC = b"GRAFTWAL"          # 8 bytes; file format v1
+_HDR = struct.Struct("<II")  # payload_len, crc32(payload)
+_POS = struct.Struct(">Q")   # end_pos, first 8 payload bytes
+
+# a record length beyond this is garbage, not a record (the serving
+# layer caps request bodies at 128 MB; columns add < 2x)
+MAX_RECORD_BYTES = 1 << 30
+
+# the deterministic kill sites (docs/DURABILITY.md §Crash-point matrix)
+CRASH_SITES = ("ack-pre-fsync", "post-fsync-pre-publish", "mid-spill",
+               "mid-fold", "mid-manifest-write")
+
+SYNC_MODES = ("commit", "batch", "off")
+
+
+class WalError(Exception):
+    """The WAL is corrupt past the tolerated torn tail (a checksum
+    mismatch mid-log, an unreadable record payload): recovery must
+    fail loudly, never serve a silent partial replay."""
+
+
+class CrashPoint(BaseException):
+    """Raised by :func:`maybe_crash` in in-process chaos mode.
+    Deliberately a ``BaseException``: the scheduler's thread-boundary
+    ``except Exception`` guards must NOT swallow a simulated crash
+    into a clean 500 — the harness wants the process-death shape
+    (nothing after the kill site runs)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"GRAFT_CRASH_POINT fired at {site!r}")
+        self.site = site
+
+
+def maybe_crash(site: str) -> None:
+    """Die here iff ``GRAFT_CRASH_POINT`` names this site.  Hard
+    process exit under ``GRAFT_CRASH_EXIT=1`` (the subprocess matrix);
+    a :class:`CrashPoint` otherwise (the in-process tier-1 harness)."""
+    if os.environ.get("GRAFT_CRASH_POINT") != site:
+        return
+    if os.environ.get("GRAFT_CRASH_EXIT"):
+        os._exit(137)
+    raise CrashPoint(site)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so freshly created/renamed entries survive a
+    POWER loss, not just a process kill (a killed process's dir
+    entries live in the kernel either way).  Best-effort: some
+    filesystems refuse directory fds."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _encode_payload(p, end_pos: int) -> bytes:
+    """One commit's applied ops as the record payload (end_pos +
+    uncompressed packed-npz — compression would put zlib on the ack
+    path for a few hundred KB of columns)."""
+    from . import engine as engine_mod
+    buf = io.BytesIO()
+    buf.write(_POS.pack(end_pos))
+    engine_mod.write_packed_npz(
+        buf, p, {"num_ops": p.num_ops,
+                 "hints_vouched": bool(p.hints_vouched)},
+        compress=False)
+    return buf.getvalue()
+
+
+def _decode_payload(payload: bytes) -> Tuple[int, Any]:
+    """Inverse of :func:`_encode_payload` → ``(end_pos, PackedOps)``.
+    The crc already vouched for the bytes, so a decode failure here is
+    a WAL bug or in-flight tampering — still a typed error."""
+    from .codec import packed as packed_mod
+    from .core.errors import CheckpointError
+    end_pos = _POS.unpack_from(payload)[0]
+    try:
+        p, _ = packed_mod.load_packed_npz(io.BytesIO(payload[_POS.size:]))
+    except CheckpointError as e:
+        raise WalError(f"crc-valid WAL record failed to decode: {e}") \
+            from e
+    return end_pos, p
+
+
+def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
+    """Parse a WAL file into ``(records, torn_dropped, good_bytes)``
+    without decoding payloads: each record is ``(offset, end_pos,
+    payload)``.  Implements the corruption taxonomy from the module
+    docstring — torn tail tolerated and counted, mid-log corruption a
+    typed :class:`WalError`.  A missing file is an empty log."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], 0, 0
+    if not data:
+        return [], 0, 0
+    if data[:len(MAGIC)] != MAGIC:
+        raise WalError(f"WAL {path!r}: bad magic "
+                       f"{data[:len(MAGIC)]!r}")
+    records: List[Tuple[int, int, bytes]] = []
+    off = len(MAGIC)
+    n = len(data)
+    while off < n:
+        if n - off < _HDR.size:
+            return records, 1, off           # torn header at EOF
+        ln, crc = _HDR.unpack_from(data, off)
+        end = off + _HDR.size + ln
+        if ln < _POS.size or ln > MAX_RECORD_BYTES or end > n:
+            # impossible length or truncated payload: only legal as
+            # the torn final record — a crash mid-append
+            return records, 1, off
+        payload = data[off + _HDR.size:end]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            if end == n:
+                return records, 1, off       # torn tail: partial write
+            raise WalError(
+                f"WAL {path!r}: checksum mismatch at offset {off} "
+                f"with {n - end} valid bytes beyond it — mid-log "
+                f"corruption, refusing a partial replay")
+        records.append((off, _POS.unpack_from(payload)[0], payload))
+        off = end
+    return records, 0, off
+
+
+class Wal:
+    """One document's write-ahead log.  Appends and fsyncs come from
+    the scheduler thread; truncation may come from the anti-entropy
+    thread (watermark GC) — a lock serializes the file handle."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mu = threading.Lock()
+        self._f: Optional[Any] = None
+        # telemetry (crdt_wal_* prom families; docs/DURABILITY.md)
+        self.appends = 0
+        self.appended_bytes = 0
+        self.fsyncs = 0
+        self.truncations = 0
+        self.errors = 0
+        self.repairs = 0
+        self.replay_records = 0
+        self.replay_ops = 0
+        self.replay_skipped = 0
+        self.torn_dropped = 0
+        self._fsync_hist = None
+        self._size = 0          # last good RECORD boundary
+        self._synced_size = 0   # last fsync-durable boundary
+        self._dirty = False     # a failed write left untracked bytes
+
+    def _histogram(self):
+        if self._fsync_hist is None:
+            from .serve.metrics import LATENCY_BOUNDS_MS, Histogram
+            self._fsync_hist = Histogram(LATENCY_BOUNDS_MS)
+        return self._fsync_hist
+
+    def _open_locked(self):
+        if self._f is None:
+            new = not os.path.exists(self.path) \
+                or os.path.getsize(self.path) == 0
+            self._f = open(self.path, "ab")
+            if new:
+                self._f.write(MAGIC)
+                self._f.flush()
+                _fsync_dir(os.path.dirname(self.path))
+            self._size = self._f.tell()
+            self._synced_size = self._size
+        return self._f
+
+    def _repair_locked(self, to_size: int) -> None:
+        """A failed write/fsync may have left partial (or
+        undurable-garbage) bytes past ``to_size``; truncate them away
+        so a later SUCCESSFUL append never buries them mid-log — a
+        torn tail must stay a torn tail, not become fatal mid-log
+        corruption at recovery.  If the disk refuses even this, stay
+        dirty: every append fails until a repair succeeds."""
+        try:
+            if self._f is not None:
+                self._f.close()
+        except OSError:
+            pass
+        self._f = None
+        try:
+            with open(self.path, "rb+") as f:
+                f.truncate(to_size)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            self._dirty = True
+            return
+        self._size = to_size
+        self._synced_size = min(self._synced_size, to_size)
+        self._dirty = False
+        self.repairs += 1
+
+    # -- write path (ack-durability: append, then sync, then ack) ---------
+
+    def append(self, p, end_pos: int) -> None:
+        """Buffer one commit's applied ops.  Raises ``OSError``
+        (ENOSPC/EIO) straight to the scheduler, which ROLLS THE MERGE
+        BACK and sheds the commit's tickets as an honest 503 instead
+        of crashing (serve/scheduler.py ``_wal_shed``) — the client's
+        retry applies for real once the disk recovers.  A failed
+        append repairs the file back to the last good record boundary
+        so the partial bytes can never be buried mid-log."""
+        payload = _encode_payload(p, end_pos)
+        rec = _HDR.pack(len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._mu:
+            if self._dirty:
+                self._repair_locked(self._size)
+                if self._dirty:
+                    self.errors += 1
+                    raise OSError(
+                        f"WAL {self.path!r} needs repair after a "
+                        f"failed write and the disk still refuses")
+            try:
+                f = self._open_locked()
+                f.write(rec)
+                f.flush()
+            except OSError:
+                self.errors += 1
+                self._repair_locked(self._size)
+                raise
+            self.appends += 1
+            self.appended_bytes += len(rec)
+            self._size += len(rec)
+
+    def sync(self) -> None:
+        """fsync everything appended so far — the durability point the
+        ack waits on.  One call covers every record buffered since the
+        last sync (the group-commit amortization).  On failure the
+        unsynced tail is truncated away: its commits are being shed
+        and rolled back, and after a writeback error the page cache
+        can no longer be trusted to match the platter (the classic
+        fsync-error hazard) — dropping the tail keeps the on-disk log
+        a clean prefix of what was ever acked."""
+        import time
+        with self._mu:
+            try:
+                f = self._open_locked()
+                t0 = time.perf_counter()
+                os.fsync(f.fileno())
+            except OSError:
+                self.errors += 1
+                self._repair_locked(self._synced_size)
+                raise
+            self._synced_size = self._size
+            self.fsyncs += 1
+            self._histogram().observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    # -- truncation (spill/fold watermark) ---------------------------------
+
+    def truncate_below(self, pos: int) -> int:
+        """Drop records whose ``end_pos`` ≤ ``pos`` (their ops are
+        durable in cold segments + manifest).  Atomic rewrite; returns
+        the number of records dropped.  A record straddling ``pos``
+        stays whole — duplicate replay absorbs."""
+        with self._mu:
+            if self._f is not None:
+                self._f.flush()
+            try:
+                records, torn, _ = scan(self.path)
+            except WalError:
+                # a live log should never be corrupt; leave the
+                # evidence in place for recovery to report
+                self.errors += 1
+                return 0
+            keep = [r for r in records if r[1] > pos]
+            if len(keep) == len(records) and not torn:
+                return 0
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(MAGIC)
+                for _, end_pos, payload in keep:
+                    f.write(_HDR.pack(
+                        len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF))
+                    f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+            os.replace(tmp, self.path)
+            _fsync_dir(os.path.dirname(self.path))
+            self._size = os.path.getsize(self.path)
+            self._synced_size = self._size
+            self._dirty = False
+            self.truncations += 1
+            return len(records) - len(keep)
+
+    # -- recovery ----------------------------------------------------------
+
+    def replay_into(self, tree, chunk_ops: int = 1 << 17) -> Dict:
+        """Re-apply the WAL tail into ``tree`` (a just-restored
+        checkpoint base + cold segments, or a fresh tree) through the
+        ordinary apply path, so dedup/ordering semantics are exactly
+        the serving engine's.  Records fully at or below the restored
+        extent are skipped (their ops are already in the tiers);
+        straddling records re-apply whole and the overlap absorbs.
+        Raises :class:`WalError` on mid-log corruption or a record
+        that fails to re-apply (an acked write that cannot be restored
+        is exactly the loss this log exists to prevent)."""
+        from .core.errors import CRDTError
+        base_len = tree.log_length
+        records, torn, _ = scan(self.path)
+        self.torn_dropped += torn
+        applied = 0
+        for _, end_pos, payload in records:
+            if end_pos <= base_len:
+                self.replay_skipped += 1
+                continue
+            _, p = _decode_payload(payload)
+            try:
+                tree.apply_packed_chunked(p, chunk_ops)
+            except CRDTError as e:
+                raise WalError(
+                    f"WAL record (end_pos {end_pos}) failed to "
+                    f"re-apply during recovery: {e!r}") from e
+            self.replay_records += 1
+            self.replay_ops += p.num_ops
+            applied += int(tree.last_applied_mask.sum()) \
+                if tree.last_applied_mask is not None else 0
+        if torn:
+            # drop the torn tail on disk too, so the next append
+            # starts at a clean record boundary
+            self.truncate_below(-1)
+        return {"records": self.replay_records,
+                "ops": self.replay_ops,
+                "applied": applied,
+                "skipped": self.replay_skipped,
+                "torn_dropped": torn,
+                "base_len": base_len,
+                "log_len": tree.log_length}
+
+    # -- lifecycle / telemetry ---------------------------------------------
+
+    def size_bytes(self) -> int:
+        with self._mu:
+            if self._f is not None:
+                return self._size
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        with self._mu:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                except OSError:
+                    self.errors += 1
+                self._f = None
+
+    def telemetry(self) -> Dict:
+        """JSON-safe counter/gauge snapshot (per-doc ``/metrics`` key
+        + the ``crdt_wal_*`` prom families)."""
+        with self._mu:
+            hist = None if self._fsync_hist is None \
+                else self._fsync_hist.export()
+        return {
+            "appends": self.appends,
+            "appended_bytes": self.appended_bytes,
+            "fsyncs": self.fsyncs,
+            "fsync_ms": hist,
+            "truncations": self.truncations,
+            "errors": self.errors,
+            "repairs": self.repairs,
+            "replay_records": self.replay_records,
+            "replay_ops": self.replay_ops,
+            "replay_skipped": self.replay_skipped,
+            "torn_dropped": self.torn_dropped,
+            "size_bytes": self.size_bytes(),
+        }
+
+
+# -- fencing epoch ---------------------------------------------------------
+
+
+def bump_epoch(dir: str) -> int:
+    """Read, increment, and persist the document's fencing epoch
+    (``epoch`` file next to the WAL) — every recovery-to-serving is a
+    new incarnation, observable in ``/metrics`` and the flight
+    stream.  Returns the NEW epoch (1 for a fresh document)."""
+    path = os.path.join(dir, "epoch")
+    try:
+        with open(path) as f:
+            prev = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        prev = 0
+    epoch = prev + 1
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(epoch))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(dir)
+    return epoch
+
+
+def sync_mode_from_env(default: str = "batch") -> str:
+    """The ``GRAFT_WAL_SYNC`` knob, validated."""
+    mode = os.environ.get("GRAFT_WAL_SYNC", default).strip() or default
+    return mode if mode in SYNC_MODES else default

@@ -1,0 +1,715 @@
+"""Crash-durable acked writes (ISSUE 9): the group-commit WAL under
+the cascade op-log, recovery-to-serving, and the crash-point matrix.
+
+The contract under test: an acknowledged write survives a kill at ANY
+point — the WAL fsyncs before the ack is released, spill/fold
+manifests keep the tiers reopenable, recovery replays the WAL tail
+through the ordinary apply path, and the recovered log's windows stay
+byte-identical to the untiered ``packed_since_window`` contract.
+Corruption is typed: a torn final record is tolerated and counted, a
+mid-log checksum flip raises ``WalError``, and a full disk sheds
+writes as honest 503s while the server keeps serving.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu import engine
+from crdt_graph_tpu import wal as wal_mod
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.codec import packed as packed_mod
+from crdt_graph_tpu.core.operation import Add, Batch, Delete
+from crdt_graph_tpu.obs import flight as flight_mod
+from crdt_graph_tpu.obs import oracle as oracle_mod
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.serve import (SchedulerStopped, ServingEngine,
+                                  WalUnavailable)
+
+OFF = 2**32
+
+
+def ts(r, c):
+    return r * OFF + c
+
+
+def chain_ops(r, n, start=1):
+    out = []
+    prev = ts(r, start - 1) if start > 1 else 0
+    for c in range(start, start + n):
+        out.append(Add(ts(r, c), (prev,), f"v{r}.{c}"))
+        prev = ts(r, c)
+    return out
+
+
+def _submit(eng, doc, ops):
+    return eng.submit(doc, json_codec.dumps(Batch(tuple(ops))))
+
+
+def _windows_match_untiered(tree, sinces=(0,), limits=(0, 7)):
+    """The recovered log's window answers vs engine.packed_since_window
+    over its own full packing — the tiered/untiered byte contract."""
+    view = tree.log_view()
+    full = view.to_packed()
+    for since in sinces:
+        for limit in limits:
+            if limit:
+                b1, m1 = view.window(since, limit)
+                b2, m2 = engine.packed_since_window(full, since, limit)
+                assert b1 == b2 and m1 == m2, (since, limit)
+            else:
+                assert view.since_bytes(since) == \
+                    engine.packed_since_bytes(full, since), since
+
+
+# -- raw WAL format + corruption taxonomy ---------------------------------
+
+
+def _raw_wal(tmp_path, n_records=3):
+    w = wal_mod.Wal(str(tmp_path / "wal.log"))
+    pos = 0
+    for k in range(n_records):
+        ops = chain_ops(1, 5, start=1 + 5 * k)
+        pos += 5
+        w.append(packed_mod.pack(ops), pos)
+    w.sync()
+    w.close()
+    return w
+
+
+def test_wal_scan_roundtrip_and_truncate_below(tmp_path):
+    w = _raw_wal(tmp_path, n_records=3)
+    records, torn, good = wal_mod.scan(w.path)
+    assert [r[1] for r in records] == [5, 10, 15] and torn == 0
+    # payloads decode back to the exact ops appended
+    _, p = wal_mod._decode_payload(records[1][2])
+    assert packed_mod.unpack_rows(p, 0, p.num_ops) == \
+        chain_ops(1, 5, start=6)
+    # truncation drops fully-covered records, keeps straddlers
+    w2 = wal_mod.Wal(w.path)
+    assert w2.truncate_below(10) == 2
+    records, torn, _ = wal_mod.scan(w.path)
+    assert [r[1] for r in records] == [15] and torn == 0
+    # idempotent; nothing below the watermark left
+    assert w2.truncate_below(10) == 0
+    w2.close()
+
+
+def test_wal_torn_final_record_tolerated_and_counted(tmp_path):
+    w = _raw_wal(tmp_path, n_records=2)
+    data = open(w.path, "rb").read()
+    for cut in (7, 1, len(data) - wal_mod.scan(w.path)[0][1][0] - 3):
+        torn_path = str(tmp_path / f"torn{cut}.log")
+        with open(torn_path, "wb") as f:
+            f.write(data[:-cut])
+        records, torn, good = wal_mod.scan(torn_path)
+        assert torn == 1 and len(records) == 1, cut
+    # a crc flip on the FINAL record is a torn tail too (partial
+    # payload write), not mid-log corruption
+    flipped = bytearray(data)
+    flipped[-3] ^= 0xFF
+    flip_path = str(tmp_path / "flip-last.log")
+    with open(flip_path, "wb") as f:
+        f.write(bytes(flipped))
+    records, torn, _ = wal_mod.scan(flip_path)
+    assert torn == 1 and len(records) == 1
+
+
+def test_wal_midlog_corruption_raises_typed(tmp_path):
+    w = _raw_wal(tmp_path, n_records=3)
+    data = bytearray(open(w.path, "rb").read())
+    records, _, _ = wal_mod.scan(w.path)
+    # flip a byte INSIDE the first record's payload: valid records
+    # continue past it, so this must be WalError, never a partial scan
+    data[records[0][0] + 12] ^= 0xFF
+    with open(w.path, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(wal_mod.WalError):
+        wal_mod.scan(w.path)
+    # and recovery through replay_into refuses too
+    t = engine.init(0)
+    with pytest.raises(wal_mod.WalError):
+        wal_mod.Wal(w.path).replay_into(t)
+    # bad magic is typed as well
+    with open(w.path, "wb") as f:
+        f.write(b"NOTAWAL!" + bytes(16))
+    with pytest.raises(wal_mod.WalError):
+        wal_mod.scan(w.path)
+
+
+def test_wal_duplicate_replay_idempotent_after_crash_mid_truncate(
+        tmp_path):
+    """A crash between the spill's manifest write and the WAL truncate
+    leaves records the tiers already cover — replay must absorb them
+    through apply dedup, bit-identically."""
+    w = _raw_wal(tmp_path, n_records=3)
+    ref = engine.init(0)
+    ref.apply(Batch(tuple(chain_ops(1, 15))))
+
+    # replay everything into a fresh tree, then replay the SAME file
+    # again (the mid-truncate shape: every record is a duplicate)
+    t = engine.init(0)
+    stats = wal_mod.Wal(w.path).replay_into(t)
+    assert stats["ops"] == 15 and t.log_length == 15
+    again = wal_mod.Wal(w.path).replay_into(t)
+    assert again["applied"] == 0, "duplicate replay must absorb"
+    assert t.log_length == 15
+    assert t.visible_values() == ref.visible_values()
+    _windows_match_untiered(t, sinces=(0, ts(1, 1), ts(1, 9)))
+
+
+def test_wal_records_deletes_and_replays_them(tmp_path):
+    """Deletes ride WAL records like adds (the all-delete tail is the
+    PR-6 window bug class — it must survive a crash too)."""
+    ops = chain_ops(1, 8) + [Delete((ts(1, c),)) for c in (2, 5, 8)]
+    ref = engine.init(0)
+    ref.apply(Batch(tuple(ops)))
+    w = wal_mod.Wal(str(tmp_path / "wal.log"))
+    w.append(packed_mod.pack(ops), len(ops))
+    w.sync()
+    w.close()
+    t = engine.init(0)
+    wal_mod.Wal(w.path).replay_into(t)
+    assert t.visible_values() == ref.visible_values()
+    assert t.log_length == ref.log_length
+    _windows_match_untiered(t, sinces=(0, ts(1, 3)), limits=(0, 4))
+
+
+def test_wal_failed_append_repairs_to_record_boundary(tmp_path):
+    """A failed append can leave partial bytes on disk (large records
+    flush incrementally before the OSError).  The repair truncates
+    back to the last good record boundary, so a LATER successful
+    append never buries the garbage mid-log — a torn tail must stay a
+    torn tail, never become fatal mid-log corruption at recovery."""
+    w = _raw_wal(tmp_path, n_records=2)
+    good = os.path.getsize(w.path)
+    # the partially-flushed failed append's residue
+    with open(w.path, "ab") as f:
+        f.write(b"\x99" * 11)
+    w2 = wal_mod.Wal(w.path)
+    w2._size = good                  # what Wal tracked pre-failure
+    w2._repair_locked(good)
+    assert w2.repairs == 1
+    w2.append(packed_mod.pack(chain_ops(1, 3, start=11)), 13)
+    w2.sync()
+    w2.close()
+    records, torn, _ = wal_mod.scan(w.path)
+    assert torn == 0 and len(records) == 3
+    # and the appended record decodes
+    _, p = wal_mod._decode_payload(records[-1][2])
+    assert packed_mod.unpack_rows(p, 0, p.num_ops) == \
+        chain_ops(1, 3, start=11)
+
+
+# -- the serving path: durability, group commit, shedding -----------------
+
+
+def _durable_engine(ddir, wal_sync="batch", **kw):
+    kw.setdefault("oplog_hot_ops", 8)
+    kw.setdefault("flight", flight_mod.FlightRecorder())
+    return ServingEngine(durable_dir=str(ddir), wal_sync=wal_sync, **kw)
+
+
+def test_recovery_to_serving_windows_epoch_and_metrics(tmp_path):
+    eng = _durable_engine(tmp_path / "dur")
+    ops = chain_ops(1, 30)
+    for i in range(0, 30, 5):
+        ok, _ = _submit(eng, "docA", ops[i:i + 5])
+        assert ok
+    doc = eng.get("docA")
+    vals = doc.snapshot()
+    m = doc.metrics()
+    assert m["durable"] and m["epoch"] == 1 and m["wal"]["fsyncs"] >= 1
+    assert eng.flush(20)
+    # abandon WITHOUT close: everything written is on disk/page cache,
+    # exactly what a kill leaves behind
+    eng2 = _durable_engine(tmp_path / "dur")
+    doc2 = eng2.get("docA", create=False)
+    assert doc2 is not None, "recovery scan must reopen the doc"
+    assert doc2.recovered and doc2.epoch == 2
+    assert doc2.snapshot() == vals
+    # recovered hot tail came through the WAL, tiers through the
+    # manifest; windows stay byte-identical to untiered at the seams
+    _windows_match_untiered(doc2.tree,
+                            sinces=(0, ts(1, 1), ts(1, 17), ts(1, 28)),
+                            limits=(0, 6))
+    # steady-state WAL stayed O(hot tail): spills truncated it
+    assert doc2.wal.telemetry()["size_bytes"] < 16384
+    eng2.close()
+    eng.close()
+
+
+def test_group_commit_one_fsync_covers_coalesced_tickets(tmp_path):
+    """batch mode: N tickets fused into one commit share ONE WAL
+    record and ONE fsync — the group-commit amortization."""
+    eng = _durable_engine(tmp_path / "dur", oplog_hot_ops=4096)
+    eng.scheduler.pause()
+    n = 6
+    results = []
+
+    def writer(rid):
+        ops = [Add(ts(rid, 1), (0,), f"w{rid}")]
+        results.append(_submit(eng, "gdoc", ops))
+
+    threads = [threading.Thread(target=writer, args=(rid,),
+                                daemon=True) for rid in range(2, 2 + n)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        d = eng.get("gdoc", create=False)
+        if d is not None and len(d.queue) == n:
+            break
+        time.sleep(0.005)
+    eng.scheduler.resume()
+    for t in threads:
+        t.join(30)
+    assert len(results) == n and all(ok for ok, _ in results)
+    doc = eng.get("gdoc")
+    w = doc.wal.telemetry()
+    assert w["appends"] == 1, w
+    assert w["fsyncs"] == 1, w
+    # the fsync is billed into the commit's flight stages
+    rec = [r for r in eng.flight.records()
+           if r.doc_id == "gdoc" and r.outcome == "committed"][-1]
+    assert rec.coalesce_width == n
+    assert "wal_fsync" in rec.stages_ms and "wal_append" in rec.stages_ms
+    eng.close()
+
+
+def test_commit_mode_fsyncs_every_commit(tmp_path):
+    eng = _durable_engine(tmp_path / "dur", wal_sync="commit")
+    for i in range(3):
+        ok, _ = _submit(eng, "cdoc", chain_ops(1, 4, start=1 + 4 * i))
+        assert ok
+    w = eng.get("cdoc").wal.telemetry()
+    assert w["fsyncs"] >= 3 and w["appends"] == 3
+    eng.close()
+
+
+def test_disk_full_sheds_503_and_server_stays_up(tmp_path):
+    """ENOSPC on the WAL path: the write is shed with the typed 503
+    mapping, the merged ops stay un-acked, reads keep serving, and the
+    disk recovering restores the write path."""
+    eng = _durable_engine(tmp_path / "dur")
+    ok, _ = _submit(eng, "ddoc", chain_ops(1, 5))
+    assert ok
+    doc = eng.get("ddoc")
+    vals_before = doc.snapshot()
+    real_sync = doc.wal.sync
+
+    def enospc():
+        raise OSError(28, "No space left on device")
+
+    doc.wal.sync = enospc
+    try:
+        with pytest.raises(WalUnavailable):
+            _submit(eng, "ddoc", chain_ops(1, 5, start=6))
+    finally:
+        doc.wal.sync = real_sync
+    # server alive: reads serve the last PUBLISHED snapshot, the
+    # scheduler thread survived, the shed is counted, and the merge
+    # was ROLLED BACK (the log must never hold ops in neither the
+    # tiers nor the WAL)
+    assert doc.snapshot() == vals_before
+    assert doc.tree.log_length == 5
+    assert eng.scheduler.is_alive()
+    assert eng.counters.snapshot().get("wal_shed_commits", 0) >= 1
+    # WalUnavailable maps through the SchedulerStopped → 503 contract
+    assert issubclass(WalUnavailable, SchedulerStopped)
+    # disk back: writes ack again (the shed delta's ops were merged
+    # un-acked; the retry's duplicates absorb)
+    ok, _ = _submit(eng, "ddoc", chain_ops(1, 5, start=6))
+    assert ok
+    assert len(eng.get("ddoc").snapshot()) == 10
+    eng.close()
+
+
+# -- the crash-point matrix (deterministic, in-process) --------------------
+
+
+@pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
+def test_crash_point_matrix_zero_acked_loss(tmp_path, site,
+                                            monkeypatch):
+    """One kill site per run: acked writes survive, the recovered doc
+    serves immediately at a bumped epoch, windows stay byte-identical,
+    and the oracle's convergence check reports zero violations over
+    the recovered serving surface.  In-process kill: the CrashPoint
+    BaseException stops the scheduler exactly at the site (nothing
+    after it runs — no fsync, no publish, no ack) and everything
+    already ``write()``-en survives in the page cache, which is
+    precisely the post-SIGKILL disk state."""
+    monkeypatch.setenv("GRAFT_OPLOG_GC_SEGS", "1")
+    ddir = tmp_path / "dur"
+    eng = _durable_engine(ddir, submit_timeout_s=2.0)
+    acked = []
+    ops = chain_ops(1, 80)
+    for i in range(0, 15, 5):
+        ok, _ = _submit(eng, "doc", ops[i:i + 5])
+        assert ok
+        acked.extend(ops[i:i + 5])
+    monkeypatch.setenv("GRAFT_CRASH_POINT", site)
+    # a 20-leaf commit from a 15-op log with hot_ops=8 forces spill →
+    # fold (gc_min_segs=1) → manifest in the armed commit, so every
+    # site fires on this one write; the ack must never come back
+    crashed = {}
+
+    def doomed():
+        try:
+            crashed["ack"] = _submit(eng, "doc", ops[15:35])
+        except SchedulerStopped:
+            crashed["ack"] = None
+
+    th = threading.Thread(target=doomed, daemon=True)
+    th.start()
+    eng.scheduler.join(20)
+    assert not eng.scheduler.is_alive(), \
+        f"site {site} never fired (scheduler survived)"
+    th.join(10)
+    assert crashed.get("ack") is None, \
+        f"site {site}: a write acked AFTER the crash point"
+    monkeypatch.delenv("GRAFT_CRASH_POINT")
+    # recover from disk (the wounded engine is abandoned, un-closed)
+    eng2 = _durable_engine(ddir)
+    doc2 = eng2.get("doc", create=False)
+    assert doc2 is not None and doc2.epoch == 2
+    vals = set(doc2.snapshot())
+    missing = [op.value for op in acked if op.value not in vals]
+    assert not missing, f"site {site} lost acked writes: {missing}"
+    _windows_match_untiered(doc2.tree,
+                            sinces=(0, ts(1, 3), ts(1, 13)),
+                            limits=(0, 6))
+    # oracle contract over the recovered serving surface: two
+    # sessions' final reads of the SAME published snapshot converge
+    oracle = oracle_mod.SessionOracle()
+    snap = doc2.read_view()
+    for sess in ("s-a", "s-b"):
+        oracle.observe_final_read(sess, "doc", snap.seq,
+                                  snap.fingerprint())
+        oracle.observe_replica_state("doc", f"n0.{doc2.epoch}",
+                                     snap.state_fingerprint())
+    assert oracle.finalize() == []
+    assert oracle.stats()["violations_total"] == 0
+    # serving-ready: the recovered doc accepts writes at once (an
+    # independent chain — the doomed batch was never acked, so a
+    # write anchored on it would be a legitimate 409)
+    ok, _ = _submit(eng2, "doc", chain_ops(9, 3))
+    assert ok
+    eng2.close()
+
+
+# -- satellites ------------------------------------------------------------
+
+
+def test_restore_tiered_preserves_last_operation(tmp_path):
+    """ISSUE 9 satellite: checkpoint_tiered/restore_tiered used to
+    drop ``last_operation`` silently; the manifest now carries the
+    span (or blob), and a restored node reports the same provenance."""
+    t = engine.init(0)
+    t.apply(Batch(tuple(chain_ops(1, 30))))
+    last = t.last_operation
+    assert len(last.ops) == 30
+    t.checkpoint_tiered(str(tmp_path / "ck"))
+    r = engine.TpuTree.restore_tiered(str(tmp_path / "ck"))
+    assert r.last_operation == last
+    assert len(r.last_operation.ops) == 30
+
+    # bare single-op shape survives too (the reference's bare echo)
+    t2 = engine.init(0)
+    t2.apply(Batch(tuple(chain_ops(2, 6))))
+    bare = Add(ts(2, 7), (ts(2, 6),), "bare")
+    t2.apply(bare)
+    assert isinstance(t2.last_operation, Add)
+    t2.checkpoint_tiered(str(tmp_path / "ck2"))
+    r2 = engine.TpuTree.restore_tiered(str(tmp_path / "ck2"))
+    assert isinstance(r2.last_operation, Add)
+    assert r2.last_operation == bare
+
+    # empty-batch sentinel: a fresh restore-of-restore keeps it
+    r2.checkpoint_tiered(str(tmp_path / "ck3"))
+    r3 = engine.TpuTree.restore_tiered(str(tmp_path / "ck3"))
+    assert r3.last_operation == r2.last_operation
+
+
+def test_catchup_503_with_priority_pull():
+    """ISSUE 9 satellite: a fleet node that doesn't hold a document a
+    peer HAS answers 503 + Retry-After + X-Catchup-Remaining (not
+    404) and triggers a priority anti-entropy pull that lands without
+    waiting out the (dormant) sync interval."""
+    from http.client import HTTPConnection
+
+    from crdt_graph_tpu.cluster import FleetServer, MemoryKV
+
+    kv = MemoryKV()
+    a = FleetServer("n0", kv, ttl_s=600, ae_interval_s=3600)
+    b = FleetServer("n1", kv, ttl_s=600, ae_interval_s=3600)
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(len(fs.node.refresh_ring()) == 2 for fs in (a, b)):
+                break
+            time.sleep(0.02)
+        # a doc primaried on n0, written through n0
+        doc = next(d for d in (f"cd{i}" for i in range(64))
+                   if a.node.primary_for(d) == "n0")
+        conn = HTTPConnection("127.0.0.1", a.port, timeout=30)
+        conn.request("POST", f"/docs/{doc}/ops",
+                     body=json_codec.dumps(Batch(tuple(chain_ops(1, 6)))))
+        assert conn.getresponse().status == 200
+        conn.close()
+        # n1 knows the doc exists (peer listing) but hasn't pulled it:
+        # exactly the restart / new-owner catch-up window
+        st = b.node.antientropy._peer_state("n0", a.addr)
+        st.known_docs = frozenset({doc})
+        conn = HTTPConnection("127.0.0.1", b.port, timeout=30)
+        conn.request("GET", f"/docs/{doc}")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 503, (resp.status, body)
+        assert resp.getheader("Retry-After") is not None
+        assert resp.getheader("X-Catchup-Remaining") == "1"
+        conn.close()
+        assert b.node.antientropy.priority_pulls >= 1
+        # the priority wake pulls the doc despite the 3600 s interval
+        deadline = time.monotonic() + 20
+        got = None
+        while time.monotonic() < deadline:
+            conn = HTTPConnection("127.0.0.1", b.port, timeout=30)
+            conn.request("GET", f"/docs/{doc}")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status == 200:
+                got = json.loads(body)["values"]
+                break
+            time.sleep(0.05)
+        assert got is not None, "priority pull never landed"
+        assert got == [f"v1.{c}" for c in range(1, 7)]
+        # an unknown doc is still an honest 404
+        conn = HTTPConnection("127.0.0.1", b.port, timeout=30)
+        conn.request("GET", "/docs/nosuchdoc")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_prom_wal_families_strict_parse(tmp_path):
+    eng = _durable_engine(tmp_path / "dur")
+    ok, _ = _submit(eng, "pdoc", chain_ops(1, 12))
+    assert ok
+    text = eng.render_prom()
+    fams = prom_mod.parse_text(text)
+    for fam in ("crdt_wal_appends_total", "crdt_wal_fsyncs_total",
+                "crdt_wal_appended_bytes_total",
+                "crdt_wal_truncations_total", "crdt_wal_errors_total",
+                "crdt_wal_size_bytes", "crdt_wal_epoch",
+                "crdt_wal_fsync_ms"):
+        assert fam in fams, fam
+    assert fams["crdt_wal_fsync_ms"]["type"] == "histogram"
+    # non-durable engines keep their scrape unchanged
+    eng2 = ServingEngine(flight=flight_mod.FlightRecorder())
+    assert not any(f.startswith("crdt_wal_")
+                   for f in prom_mod.parse_text(eng2.render_prom()))
+    eng2.close()
+    eng.close()
+
+
+# -- process-level matrix + fleet soak + headline (slow) -------------------
+
+
+def _proc_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
+def test_wal_crash_point_process_matrix(tmp_path, site):
+    """The real thing: a server process dies by os._exit(137) at the
+    armed site mid-HTTP-traffic; a fresh engine recovers the durable
+    dir with zero acked-write loss."""
+    ddir = str(tmp_path / "dur")
+    ack_log = str(tmp_path / "acked.txt")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "_wal_crash_worker.py"),
+         site, ddir, ack_log],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env=_proc_env(), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 137, \
+        (site, proc.returncode, proc.stdout[-800:], proc.stderr[-800:])
+    acked = [ln for ln in open(ack_log).read().splitlines() if ln]
+    assert acked, "worker crashed before anything was acked"
+    eng = ServingEngine(durable_dir=ddir, wal_sync="batch",
+                        flight=flight_mod.FlightRecorder())
+    doc = eng.get("crash", create=False)
+    assert doc is not None
+    vals = set(doc.snapshot())
+    missing = [v for v in acked if v not in vals]
+    assert not missing, f"site {site} lost acked writes: {missing}"
+    assert doc.epoch == 2
+    _windows_match_untiered(doc.tree, sinces=(0,), limits=(0, 6))
+    eng.close()
+
+
+@pytest.mark.slow
+def test_wal_sigkill_fleet_soak(tmp_path):
+    """SIGKILL matrix over the fleet, WAL on: a durable node dies hard
+    mid-traffic with acked writes only in its WAL (anti-entropy
+    dormant), restarts under its old name, recovers its docs from
+    disk, and the fleet converges with every acked value present."""
+    import signal
+
+    spool = str(tmp_path / "kv")
+    durdirs = {n: str(tmp_path / f"dur-{n}") for n in ("n0", "n1")}
+    procs, infos = {}, {}
+
+    def spawn(name, ae_interval):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "crdt_graph_tpu.cluster", "--cpu",
+             "--name", name, "--kv-dir", spool, "--port", "0",
+             "--ttl", "2.0", "--ae-interval", str(ae_interval),
+             "--durable-dir", durdirs[name], "--wal-sync", "batch"],
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+            env=_proc_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        line = proc.stdout.readline()
+        assert line.startswith("READY "), line
+        return proc, json.loads(line[len("READY "):])
+
+    def req(port, method, path, body=None, timeout=60):
+        from http.client import HTTPConnection
+        conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            return resp.status, resp.read(), dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    try:
+        # a LONG anti-entropy interval: the victim's acked writes must
+        # survive through its WAL, not through replication
+        for n in durdirs:
+            procs[n], infos[n] = spawn(n, ae_interval=30.0)
+        ports = {n: int(i["addr"].rsplit(":", 1)[1])
+                 for n, i in infos.items()}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            views = {n: json.loads(req(p, "GET", "/cluster")[1])
+                     for n, p in ports.items()}
+            if all(len(v["members"]) == 2 for v in views.values()):
+                break
+            time.sleep(0.1)
+        # find a doc primaried on n0 and push acked writes to it
+        doc = None
+        for cand in (f"soak{i}" for i in range(64)):
+            st, raw, _ = req(ports["n0"], "POST",
+                             f"/docs/{cand}/ops",
+                             body=json_codec.dumps(
+                                 Batch(tuple(chain_ops(1, 5)))))
+            assert st == 200
+            if json.loads(raw)["served_by"]["name"] == "n0":
+                doc = cand
+                break
+        assert doc is not None
+        acked = [f"v1.{c}" for c in range(1, 6)]
+        for k in range(5):
+            ops = chain_ops(1, 5, start=6 + 5 * k)
+            st, raw, _ = req(ports["n0"], "POST", f"/docs/{doc}/ops",
+                             body=json_codec.dumps(Batch(tuple(ops))))
+            out = json.loads(raw)
+            assert st == 200 and out["accepted"], out
+            if out["served_by"]["name"] == "n0":
+                acked += [op.value for op in ops]
+        # SIGKILL the primary: its acked hot tail exists ONLY in its
+        # durable dir (anti-entropy hasn't run)
+        procs["n0"].send_signal(signal.SIGKILL)
+        procs["n0"].wait(30)
+        procs.pop("n0").stdout.close()
+        # restart under the old name: recovery-to-serving from disk
+        p_new, info_new = spawn("n0", ae_interval=0.2)
+        procs["n0"] = p_new
+        assert info_new["epoch"] >= 2
+        assert doc in info_new["recovered_docs"], info_new
+        ports["n0"] = int(info_new["addr"].rsplit(":", 1)[1])
+        # the recovered node serves the doc IMMEDIATELY (no 404/503)
+        st, raw, hdr = req(ports["n0"], "GET", f"/docs/{doc}")
+        assert st == 200
+        vals = set(json.loads(raw)["values"])
+        missing = [v for v in acked if v not in vals]
+        assert not missing, f"SIGKILL lost acked writes: {missing}"
+        # and the fleet converges to fingerprint-equal state
+        deadline = time.monotonic() + 120
+        fps = {}
+        while time.monotonic() < deadline:
+            fps = {}
+            for n, p in ports.items():
+                st, raw, hdr = req(p, "GET", f"/docs/{doc}")
+                if st == 200:
+                    fps[n] = hdr.get("X-State-Fingerprint")
+            if len(fps) == 2 and len(set(fps.values())) == 1:
+                break
+            time.sleep(0.5)
+        assert len(set(fps.values())) == 1, fps
+    finally:
+        for p in procs.values():
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs.values():
+            try:
+                p.wait(20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_bench_wal_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_WAL_r01_cpu.json shape):
+    off/batch/commit legs of the loadgen serving shape, oracle-clean,
+    batch fsyncs amortized below commit's, and the batch-vs-off
+    acked-throughput regression inside a noise-tolerant bound (the
+    committed artifact holds the honest ≤ 25% number; the CPU driver
+    box is ±40% run-to-run, so the gate here is looser)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bench_wal_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_wal_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_WAL_test.json"),
+                  n_sessions=12, writes_per_session=6, rounds=2)
+    best = out["best"]
+    for mode in ("off", "batch", "commit"):
+        assert best[mode]["violations"] == 0
+        assert best[mode]["writes_acked"] >= 72
+        assert best[mode]["ack_p50_ms"] is not None
+    assert best["off"]["wal"]["fsyncs"] == 0
+    assert best["batch"]["wal"]["fsyncs"] >= 1
+    # group commit amortizes within commits: one record and one fsync
+    # per COMMIT, never per ticket (cross-mode fsync counts are not
+    # comparable — they track commit counts, which vary with how much
+    # coalescing each run's timing produced)
+    for mode in ("batch", "commit"):
+        w = best[mode]["wal"]
+        assert w["fsyncs"] <= best[mode]["writes_acked"], (mode, w)
+        assert w["appends"] == w["fsyncs"], (mode, w)
+    assert out["batch_vs_off_regression"] <= 0.5
