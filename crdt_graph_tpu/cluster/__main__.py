@@ -38,6 +38,12 @@ def main(argv=None) -> int:
     ap.add_argument("--wal-sync", default="batch",
                     choices=("commit", "batch", "off"),
                     help="WAL fsync policy (only with --durable-dir)")
+    ap.add_argument("--wal-shared", action="store_true",
+                    help="multiplex every document's WAL records into "
+                         "ONE per-node stream: one fsync per scheduler "
+                         "round covers all documents (GRAFT_WAL_SHARED; "
+                         "docs/DURABILITY.md §Shared WAL) — the "
+                         "many-small-docs fleet shape")
     ap.add_argument("--cpu", action="store_true",
                     help="pin this node to the host CPU backend "
                          "(localhost test fleets: scrubs the TPU "
@@ -62,6 +68,7 @@ def main(argv=None) -> int:
         from ..serve import ServingEngine
         engine = ServingEngine(durable_dir=args.durable_dir,
                                wal_sync=args.wal_sync,
+                               wal_shared=args.wal_shared,
                                flight=flight_mod.FlightRecorder())
     fs = FleetServer(args.name, FileKV(args.kv_dir), port=args.port,
                      engine=engine,

@@ -30,6 +30,12 @@ Pulled deltas enter through the ordinary write path
 (``ServedDoc.apply_body`` → scheduler → published snapshot), so synced
 ops are observable exactly like client writes: commit records, trace
 ids (``ae-<node>-<n>``), and oracle-visible snapshot publishes.
+
+Serving-side cost of a MID-HISTORY catch-up (a rejoining node resuming
+from an old mark): the peer's window resolves against its chunked
+checkpoint base (oplog.py) and loads only the chunks covering the
+requested rows — O(window), no longer one whole-base load per first
+cold pull (docs/OPLOG.md §Chunked base).
 """
 from __future__ import annotations
 

@@ -53,6 +53,20 @@ from .ops.merge import APPLIED, INVALID_PATH, NOT_FOUND, NodeTable
 DELTA_THRESHOLD = 256
 
 
+class MatzWarning(UserWarning):
+    """A persisted materialization artifact (``matz-*.npz``) was
+    present but unusable — corrupt, truncated, or inconsistent with
+    the restored log.  The restore FALLS BACK to the full first-merge
+    materialization (slow but always correct) and warns with this
+    type so operators can see cold paths silently losing their
+    O(tail) guarantee.  Never affects data correctness."""
+
+
+def matz_enabled() -> bool:
+    """The ``GRAFT_MATZ`` kill switch (default on)."""
+    return os.environ.get("GRAFT_MATZ", "1").strip() != "0"
+
+
 def _mode(p: PackedOps) -> Optional[str]:
     """Kernel hint mode for a packed batch: the cond-free "exhaustive"
     path when this engine's own ingest vouched for hint completeness
@@ -325,6 +339,13 @@ class TpuTree:
         # boundaries; a multi-chunk apply defers them so a failing
         # chunk's rollback target range is always still hot
         self._defer_spill = False
+        # persisted materialization (docs/DURABILITY.md): True when a
+        # restore found a matz artifact in the manifest — the first
+        # mirror build loads it and replays only the tail instead of
+        # merging the whole history
+        self._matz_pending = False
+        self.matz_stats: dict = {"writes": 0, "loads": 0,
+                                 "fallbacks": 0, "tail_replayed": 0}
 
     # -- identity / clocks (parity: CRDTree.elm:130-139, 337-350) ---------
 
@@ -391,10 +412,18 @@ class TpuTree:
         return self._table
 
     def _ensure_mirror(self) -> HostTree:
-        """The host mirror, built lazily: from an existing table when one
-        is materialised, through the kernel for big logs, by sequential
-        replay for small ones."""
+        """The host mirror, built lazily: from a persisted
+        materialization artifact + tail replay when a tiered restore
+        left one pending (O(tail since artifact) — the cold-path
+        collapse), from an existing table when one is materialised,
+        through the kernel for big logs, by sequential replay for
+        small ones."""
         if self._mirror is None:
+            if self._matz_pending and self._table is None:
+                m = self._load_matz_mirror()
+                if m is not None:
+                    self._mirror = m
+                    return m
             if self._table is None and len(self._log) <= DELTA_THRESHOLD:
                 m = HostTree(self._max_depth)
                 for op in self._log:
@@ -477,7 +506,10 @@ class TpuTree:
                            auto_stable: bool = True,
                            cache_segments: int = 2,
                            ephemeral: bool = False,
-                           durable: bool = False) -> "TpuTree":
+                           durable: bool = False,
+                           cache_mb: Optional[int] = None,
+                           base_chunk_ops: Optional[int] = None,
+                           cache=None) -> "TpuTree":
         """Arm the op log's three-tier cascade (oplog module
         docstring): hot ops past the budget spill to packed-npz
         segments under ``dir`` at commit boundaries, a stability-
@@ -490,7 +522,8 @@ class TpuTree:
             gc_min_segs=gc_min_segs, auto_stable=auto_stable,
             cache_segments=cache_segments, ephemeral=ephemeral,
             max_depth=self._max_depth, on_spill=self._on_log_spill,
-            durable=durable)
+            durable=durable, cache_mb=cache_mb,
+            base_chunk_ops=base_chunk_ops, cache=cache)
         return self
 
     def begin_commit(self) -> tuple:
@@ -1002,8 +1035,13 @@ class TpuTree:
         return self._ensure_packed()
 
     def visible_values(self) -> List[Any]:
-        """Visible values in document order — the render path."""
+        """Visible values in document order — the render path.  A
+        mirror freshly loaded from a materialization artifact answers
+        from its persisted visible sequence (one list copy) until the
+        first applied mutation invalidates it."""
         m = self._ensure_mirror()
+        if m.vis_cache is not None:
+            return list(m.vis_cache)
         return [m.values[int(m.value_ref[s])] for s in m.iter_visible()]
 
     # -- node views and traversal (parity: CRDTree.elm:423-625) -----------
@@ -1400,14 +1438,172 @@ class TpuTree:
             tree._last_operation = PackedBatch(p, s, e)
         return tree
 
-    def checkpoint_tiered(self, dir: str) -> str:
-        """Tiered checkpoint: the cascade's base + cold segments stay
-        where they are, the hot tail spills to one final segment, and a
-        ``manifest.json`` (tier layout + clocks/cursor meta) makes the
-        directory self-describing — so restore is *checkpoint + tail*
-        (descriptor opens, O(tail) work) instead of a full-history
-        replay.  An untiered tree enables the cascade at ``dir`` first
-        (non-ephemeral: a checkpoint must survive its writer).
+    # -- persisted materialization (docs/DURABILITY.md §Cold paths) -------
+
+    def _matz_mirror_cheap(self) -> Optional[HostTree]:
+        """The mirror IF it is derivable without a full-history merge:
+        already built, rebuildable from a parked table, loadable from
+        a pending artifact, or a small log.  None otherwise — a matz
+        write must never INTRODUCE the cold-path cost it exists to
+        remove."""
+        if self._mirror is not None or self._table is not None \
+                or self._matz_pending \
+                or len(self._log) <= DELTA_THRESHOLD:
+            return self._ensure_mirror()
+        return None
+
+    def _write_matz_file(self, target: str,
+                         fsync: bool = False) -> Optional[dict]:
+        """Write the materialization artifact (mirror slot arrays +
+        values + visible sequence) into ``target`` and return its
+        manifest entry ``{"file", "len"}``, or None when no mirror is
+        cheaply derivable.  tmp+rename so a manifest-referenced
+        artifact is never observed half-written."""
+        import json
+        m = self._matz_mirror_cheap()
+        if m is None:
+            return None
+        length = len(self._log)
+        name = self._log.next_matz_name() \
+            if self._log.tiering_enabled else "matz-g1.npz"
+        os.makedirs(target, exist_ok=True)
+        path = os.path.join(target, name)
+        tmp = path + ".tmp"
+        arrs = m.export_arrays()
+        meta = {"kind": "matz", "matz_len": length, "n": m.n,
+                "nvis": m.nvis, "max_depth": self._max_depth,
+                "values_len": len(m.values)}
+        with open(tmp, "wb") as f:
+            np.savez(f, values=np.frombuffer(
+                json.dumps(m.values).encode(), np.uint8),
+                meta=np.frombuffer(json.dumps(meta).encode(),
+                                   np.uint8),
+                **arrs)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self.matz_stats["writes"] += 1
+        return {"file": name, "len": length}
+
+    def write_matz(self) -> bool:
+        """Serving-path materialization snapshot: spill the whole hot
+        tail (so the artifact's coverage is ≤ the tiered extent — a
+        restore always finds every covered op in the tiers, never in
+        an unsynced WAL tail that might not have survived), write the
+        artifact, and publish it atomically in the manifest.  Returns
+        True when an artifact landed.  Requires tiering; no-op when
+        the mirror is not cheaply derivable or ``GRAFT_MATZ=0``."""
+        from .wal import maybe_crash
+        log = self._log
+        if not matz_enabled() or not log.tiering_enabled:
+            return False
+        if self._matz_mirror_cheap() is None:
+            return False
+        log.spill_all()
+        cfg = log._cfg
+        entry = self._write_matz_file(cfg.dir, fsync=cfg.durable)
+        if entry is None:
+            return False
+        # chaos site: artifact on disk, manifest not yet referencing
+        # it — recovery from the old manifest ignores the stray file
+        maybe_crash("mid-matz-write")
+        log.note_matz(entry["file"], entry["len"])
+        return True
+
+    def _load_matz_mirror(self) -> Optional[HostTree]:
+        """Rebuild the mirror from the manifest's materialization
+        artifact + an O(tail) replay of the ops past its coverage.
+        Any inconsistency — corrupt/truncated/missing artifact, a
+        coverage beyond the restored log, a tail op the artifact
+        state rejects — falls back to the full first-merge path with
+        a typed :class:`MatzWarning` and a counted fallback: stale is
+        absorbed, wrong is impossible, slow is the worst case."""
+        import json
+        import struct
+        import warnings
+        import zipfile
+        import zlib
+        from .core.errors import CheckpointError
+        self._matz_pending = False          # consume once
+        log = self._log
+        cfg = log._cfg
+        entry = log.matz_entry
+        if entry is None or cfg is None or not matz_enabled():
+            return None
+        length = int(entry["len"])
+        try:
+            if length > len(log):
+                raise CheckpointError(
+                    f"matz artifact covers {length} ops; restored "
+                    f"log holds {len(log)}")
+            z = np.load(os.path.join(cfg.dir, entry["file"]))
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("kind") != "matz" \
+                    or int(meta["matz_len"]) != length:
+                raise ValueError(f"matz meta mismatch: {meta!r}")
+            nvis = int(meta["nvis"])
+            values = json.loads(bytes(z["values"]).decode())
+            if not isinstance(values, list) \
+                    or len(values) != int(meta["values_len"]):
+                raise ValueError("matz value table inconsistent")
+            arrs = {k: z[k] for k in
+                    ("ts", "parent", "depth", "value_ref", "tomb",
+                     "first", "nxt", "prv")}
+            m = HostTree.from_arrays(arrs, values, self._max_depth,
+                                     nvis)
+            vis_refs = np.asarray(z["vis_refs"])
+            if vis_refs.shape != (nvis,) or (nvis and (
+                    int(vis_refs.min()) < 0
+                    or int(vis_refs.max()) >= len(values))):
+                raise ValueError("matz visible sequence inconsistent")
+            vals_arr = np.empty(len(values), dtype=object)
+            vals_arr[:] = values
+            m.vis_cache = vals_arr[vis_refs].tolist()
+            # tail replay: only the ops past the artifact's coverage
+            # (loads only their covering chunks); duplicates absorb,
+            # anything the artifact state rejects is inconsistency
+            tail = log.materialize(length, len(log))
+            for op in tail:
+                if isinstance(op, Add):
+                    st = m.apply_add(op.ts, tuple(op.path), op.value)
+                else:
+                    st = m.apply_delete(tuple(op.path))
+                if st in (NOT_FOUND, INVALID_PATH):
+                    raise CheckpointError(
+                        f"matz tail replay rejected {op!r}")
+            m.journal.clear()
+        except (CheckpointError, OSError, zipfile.BadZipFile,
+                zlib.error, KeyError, IndexError, ValueError,
+                TypeError, AttributeError, EOFError,
+                struct.error) as e:
+            self.matz_stats["fallbacks"] += 1
+            warnings.warn(
+                f"materialization artifact unusable "
+                f"({type(e).__name__}: {e}); falling back to the "
+                f"full first-merge materialization", MatzWarning,
+                stacklevel=3)
+            return None
+        self.matz_stats["loads"] += 1
+        self.matz_stats["tail_replayed"] += len(log) - length
+        return m
+
+    def checkpoint_tiered(self, dir: str,
+                          write_matz: bool = True) -> str:
+        """Tiered checkpoint: the cascade's base chunks + cold
+        segments stay where they are, the hot tail spills to one final
+        segment, and a ``manifest.json`` (tier layout + clocks/cursor
+        meta) makes the directory self-describing — so restore is
+        *checkpoint + tail* (descriptor opens, O(tail) work) instead
+        of a full-history replay.  An untiered tree enables the
+        cascade at ``dir`` first (non-ephemeral: a checkpoint must
+        survive its writer).
+
+        ``write_matz`` (and ``GRAFT_MATZ``): also persist the
+        MATERIALIZED state artifact when the mirror/table is already
+        in hand, so the restored document's FIRST READ is O(tail)
+        too, not one full-history merge.  Skipped silently when
+        deriving it would itself cost a full merge.
 
         ``last_operation`` is NOT persisted (same policy as the served
         snapshot wire format): a restoring consumer is bootstrapping,
@@ -1425,7 +1621,12 @@ class TpuTree:
         # provenance then survives the round trip instead of silently
         # resetting to an empty batch (ISSUE 9 satellite)
         self._last_op_meta(meta)
-        path = self._log.persist(meta, dir=dir)
+        matz_entry = None
+        if write_matz and matz_enabled():
+            cfg = self._log._cfg
+            matz_entry = self._write_matz_file(
+                dir, fsync=cfg.durable if cfg is not None else False)
+        path = self._log.persist(meta, dir=dir, matz=matz_entry)
         # the hot tail just spilled: drop the monolithic cache like any
         # other spill (persist bypasses the maybe_spill hook)
         self._packed = None
@@ -1433,11 +1634,18 @@ class TpuTree:
 
     @staticmethod
     def restore_tiered(dir: str, replica: Optional[int] = None,
+                       use_matz: bool = True,
                        **tier_kw) -> "TpuTree":
         """Rebuild a tree from :meth:`checkpoint_tiered` output —
         O(tail) descriptor opens, no replay, no full column load (cold
-        tiers page in lazily on first read).  ``replica`` adopts a new
-        identity exactly like :meth:`restore_packed`.  Raises
+        tiers page in lazily on first read).  When the manifest
+        references a materialization artifact (and ``use_matz`` /
+        ``GRAFT_MATZ`` allow), the FIRST READ also stays O(tail): the
+        mirror loads from the artifact and replays only the ops past
+        its coverage; a corrupt/stale/missing artifact falls back to
+        the full merge with a :class:`MatzWarning` — never wrong
+        data.  ``replica`` adopts a new identity exactly like
+        :meth:`restore_packed`.  Raises
         :class:`~crdt_graph_tpu.core.errors.CheckpointError` (typed,
         never a silent partial log) on any missing or corrupt manifest
         or segment file."""
@@ -1478,6 +1686,8 @@ class TpuTree:
         log._cfg.max_depth = max_depth
         tree._log = log
         log.set_on_spill(tree._on_log_spill)
+        if use_matz and log.matz_entry is not None:
+            tree._matz_pending = True
         tree._cursor = cursor
         tree._replicas = replicas
         if rid == rid_meta:

@@ -47,7 +47,7 @@ class HostTree:
 
     __slots__ = ("ts", "parent", "depth", "value_ref", "tomb", "first",
                  "nxt", "prv", "paths", "n", "nvis", "max_depth",
-                 "ts2slot", "values", "journal")
+                 "_ts2slot", "values", "journal", "vis_cache")
 
     def __init__(self, max_depth: int, capacity: int = 64):
         cap = max(capacity, 8)
@@ -63,11 +63,30 @@ class HostTree:
         self.paths = np.zeros((cap, max_depth), np.int64)
         self.n = 1                                  # slot 0 = root
         self.nvis = 0                               # visible-node count
-        self.ts2slot: dict = {}
+        self._ts2slot: Optional[dict] = {}
         self.values: List[Any] = []
         # undo journal for batch atomicity; entries are applied ops in
         # order, rolled back LIFO
         self.journal: List[tuple] = []
+        # visible-values-in-doc-order cache: populated by the persisted
+        # materialization loader (engine._load_matz_mirror) so the
+        # first read after a restore skips the O(n) visible traversal;
+        # invalidated by ANY applied mutation
+        self.vis_cache: Optional[List[Any]] = None
+
+    @property
+    def ts2slot(self) -> dict:
+        """timestamp → slot index.  Built lazily after a bulk
+        construction (``from_arrays`` defers it: a restored mirror
+        that only ever serves reads never needs the dict)."""
+        if self._ts2slot is None:
+            self._ts2slot = dict(zip(self.ts[1:self.n].tolist(),
+                                     range(1, self.n)))
+        return self._ts2slot
+
+    @ts2slot.setter
+    def ts2slot(self, d: Optional[dict]) -> None:
+        self._ts2slot = d
 
     # -- construction ----------------------------------------------------
 
@@ -116,6 +135,71 @@ class HostTree:
         t.ts2slot = dict(zip(t.ts[1:k + 1].tolist(), range(1, k + 1)))
         t.values = list(values)
         t.nvis = int(np.asarray(table.num_visible))
+        return t
+
+    # -- persisted materialization (engine.write_matz round trip) ---------
+
+    def export_arrays(self) -> dict:
+        """The mirror's slot arrays for the materialization artifact
+        (engine.TpuTree.write_matz).  ``paths`` is OMITTED — it
+        rebuilds from (parent, ts, depth) in :meth:`from_arrays`, and
+        at scale it is by far the widest plane (n × max_depth × 8 B).
+        ``vis_refs`` is the visible sequence's value refs in document
+        order: the restored first read becomes one list indexing pass
+        instead of an O(n) linked-list traversal."""
+        n = self.n
+        vis_refs = np.fromiter(
+            (self.value_ref[s] for s in self.iter_visible()),
+            dtype=np.int32, count=self.nvis)
+        return {"ts": self.ts[:n], "parent": self.parent[:n],
+                "depth": self.depth[:n],
+                "value_ref": self.value_ref[:n], "tomb": self.tomb[:n],
+                "first": self.first[:n], "nxt": self.nxt[:n],
+                "prv": self.prv[:n], "vis_refs": vis_refs}
+
+    @classmethod
+    def from_arrays(cls, arrs: dict, values: List[Any],
+                    max_depth: int, nvis: int) -> "HostTree":
+        """Inverse of :meth:`export_arrays`: rebuild the mirror from
+        persisted slot arrays.  ``paths`` rebuilds vectorized level by
+        level (a child's path = its parent's path + its own ts);
+        ``ts2slot`` stays lazy (read-only consumers never pay it).
+        Raises ``ValueError`` on structurally inconsistent arrays —
+        the caller maps it into the typed corrupt-artifact fallback."""
+        names = ("ts", "parent", "depth", "value_ref", "tomb",
+                 "first", "nxt", "prv")
+        n = int(np.asarray(arrs["ts"]).shape[0])
+        if n < 1:
+            raise ValueError("matz arrays hold no root slot")
+        t = cls(max_depth, capacity=n)
+        for name, dtype in zip(names, (np.int64, np.int32, np.int32,
+                                       np.int32, bool, np.int32,
+                                       np.int32, np.int32)):
+            a = np.asarray(arrs[name])
+            if a.shape != (n,):
+                raise ValueError(f"matz array {name} shape {a.shape}")
+            getattr(t, name)[:n] = a.astype(dtype, copy=False)
+        t.n = n
+        t.nvis = int(nvis)
+        depth = t.depth[:n]
+        if n > 1:
+            d_max = int(depth.max())
+            if d_max > max_depth or int(depth[1:].min()) < 1:
+                raise ValueError("matz depth column out of range")
+            parent = t.parent[:n]
+            if int(parent.min()) < 0 or int(parent.max()) >= n:
+                raise ValueError("matz parent column out of range")
+            for d in range(1, d_max + 1):
+                sl = np.nonzero(depth == d)[0]
+                if not sl.size:
+                    continue
+                if d > 1:
+                    if np.any(depth[parent[sl]] != d - 1):
+                        raise ValueError("matz parent depth mismatch")
+                    t.paths[sl, :d - 1] = t.paths[parent[sl], :d - 1]
+                t.paths[sl, d - 1] = t.ts[sl]
+        t.values = list(values)
+        t.ts2slot = None        # lazy (property builds on first use)
         return t
 
     # -- growth ----------------------------------------------------------
@@ -203,6 +287,7 @@ class HostTree:
         self.ts2slot[ts] = slot
         self.nvis += 1          # a fresh add is visible (descent proved
                                 # no tombstoned ancestor)
+        self.vis_cache = None
         self.journal.append(("add", slot, cur, prev))
         return APPLIED
 
@@ -228,6 +313,7 @@ class HostTree:
         dvis = 1 + sum(1 for _ in self.iter_visible(s))
         self.tomb[s] = True
         self.nvis -= dvis
+        self.vis_cache = None
         self.journal.append(("del", s, dvis))
         return APPLIED
 
@@ -238,6 +324,8 @@ class HostTree:
 
     def rollback(self, savepoint: int) -> None:
         """Undo journal entries back to ``savepoint`` (LIFO)."""
+        if len(self.journal) > savepoint:
+            self.vis_cache = None
         while len(self.journal) > savepoint:
             entry = self.journal.pop()
             if entry[0] == "add":

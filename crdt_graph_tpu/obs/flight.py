@@ -83,6 +83,10 @@ class CommitRecord:
     fingerprint: Optional[str]
     audit: Optional[Dict[str, Any]] = None
     error: Optional[str] = None
+    # persisted materialization (docs/DURABILITY.md §Cold paths):
+    # True/False on commits of a RECOVERED durable document — whether
+    # its first-read state came off the matz artifact; None elsewhere
+    matz_hit: Optional[bool] = None
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

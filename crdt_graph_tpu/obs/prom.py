@@ -189,7 +189,11 @@ def render_engine(engine) -> str:
          "Cold segments folded into the base and collected",
          "segments_gc"),
         ("crdt_oplog_segment_loads_total",
-         "Cold segment loads (cache misses)", "segment_loads"),
+         "Cold segment/base-chunk loads (cache misses)",
+         "segment_loads"),
+        ("crdt_oplog_cache_evictions_total",
+         "Segment/chunk LRU evictions (GRAFT_OPLOG_CACHE_MB)",
+         "cache_evictions"),
     )
     oplog_gauges = (
         ("crdt_oplog_resident_bytes",
@@ -240,49 +244,130 @@ def render_engine(engine) -> str:
     # default ephemeral engine's scrape is unchanged
     wdocs = [(d, d.wal.telemetry()) for d in docs if d.wal is not None]
     if wdocs:
-        wal_counters = (
+        shared_mode = getattr(engine, "shared_wal", None) is not None
+        wal_counters = [
             ("crdt_wal_appends_total",
              "Commit records appended to the WAL", "appends"),
             ("crdt_wal_appended_bytes_total",
              "Bytes appended to the WAL", "appended_bytes"),
-            ("crdt_wal_fsyncs_total",
-             "WAL fsyncs (one may cover a whole group commit)",
-             "fsyncs"),
             ("crdt_wal_truncations_total",
              "WAL prefix truncations at spill/fold watermarks",
              "truncations"),
-            ("crdt_wal_errors_total",
-             "WAL append/fsync failures (shed as 503)", "errors"),
             ("crdt_wal_replay_records_total",
              "Records replayed at the last recovery",
              "replay_records"),
             ("crdt_wal_torn_tail_dropped_total",
              "Torn final records dropped at recovery",
              "torn_dropped"),
-        )
+        ]
+        if not shared_mode:
+            # stream-scoped series render per-doc only when every doc
+            # HAS its own stream; in shared mode they live ONCE under
+            # crdt_wal_shared_* (a per-doc rendering would repeat the
+            # whole stream's totals once per document)
+            wal_counters += [
+                ("crdt_wal_fsyncs_total",
+                 "WAL fsyncs (one may cover a whole group commit)",
+                 "fsyncs"),
+                ("crdt_wal_errors_total",
+                 "WAL append/fsync failures (shed as 503)", "errors"),
+            ]
         for name, help_text, key in wal_counters:
             w.family(name, "counter", help_text)
             for d, t in wdocs:
                 w.sample(name, name, t[key], {"doc": d.doc_id})
-        w.family("crdt_wal_size_bytes", "gauge",
-                 "Current WAL file size (O(hot tail) steady-state)")
+        if not shared_mode:
+            w.family("crdt_wal_size_bytes", "gauge",
+                     "Current WAL file size (O(hot tail) "
+                     "steady-state)")
+            for d, t in wdocs:
+                w.sample("crdt_wal_size_bytes", "crdt_wal_size_bytes",
+                         t["size_bytes"], {"doc": d.doc_id})
         w.family("crdt_wal_epoch", "gauge",
                  "Fencing epoch (bumped at every recovery-to-serving)")
         for d, t in wdocs:
-            w.sample("crdt_wal_size_bytes", "crdt_wal_size_bytes",
-                     t["size_bytes"], {"doc": d.doc_id})
             w.sample("crdt_wal_epoch", "crdt_wal_epoch", d.epoch,
                      {"doc": d.doc_id})
-        w.family("crdt_wal_fsync_ms", "histogram",
-                 "WAL fsync latency (the durability tax per sync)")
-        for d, t in wdocs:
-            h = t["fsync_ms"]
+        if not shared_mode:
+            w.family("crdt_wal_fsync_ms", "histogram",
+                     "WAL fsync latency (the durability tax per "
+                     "sync)")
+            for d, t in wdocs:
+                h = t["fsync_ms"]
+                if h is not None:
+                    w.histogram("crdt_wal_fsync_ms",
+                                "WAL fsync latency (the durability "
+                                "tax per sync)",
+                                h["bounds"], h["counts"], h["count"],
+                                h["sum"], {"doc": d.doc_id})
+
+    # -- persisted materialization (docs/DURABILITY.md §Cold paths) -------
+    # rendered only for durable engines, like the WAL families
+    if getattr(engine, "durable_dir", None) is not None and docs:
+        matz_counters = (
+            ("crdt_matz_writes_total",
+             "Materialization artifacts written", "writes"),
+            ("crdt_matz_loads_total",
+             "Restores whose first read came off the artifact",
+             "loads"),
+            ("crdt_matz_fallbacks_total",
+             "Artifacts unusable — fell back to the full first merge",
+             "fallbacks"),
+            ("crdt_matz_tail_replayed_total",
+             "Ops replayed past artifact coverage at load",
+             "tail_replayed"),
+        )
+        for name, help_text, key in matz_counters:
+            w.family(name, "counter", help_text)
+            for d in docs:
+                w.sample(name, name, d.tree.matz_stats[key],
+                         {"doc": d.doc_id})
+        w.family("crdt_matz_covered_ops", "gauge",
+                 "Log ops covered by the live artifact")
+        for d, t in tele:
+            w.sample("crdt_matz_covered_ops", "crdt_matz_covered_ops",
+                     t["matz_len"], {"doc": d.doc_id})
+
+    # -- shared group-commit WAL stream (GRAFT_WAL_SHARED) ----------------
+    shared = getattr(engine, "shared_wal", None)
+    if shared is not None:
+        st = shared.telemetry()
+        for name, help_text, key in (
+                ("crdt_wal_shared_appends_total",
+                 "Commit records appended to the shared stream",
+                 "appends"),
+                ("crdt_wal_shared_appended_bytes_total",
+                 "Bytes appended to the shared stream",
+                 "appended_bytes"),
+                ("crdt_wal_shared_fsyncs_total",
+                 "Shared-stream fsyncs (ONE covers every document "
+                 "in the round)", "fsyncs"),
+                ("crdt_wal_shared_compactions_total",
+                 "Stream compactions at per-doc durable marks",
+                 "compactions"),
+                ("crdt_wal_shared_errors_total",
+                 "Shared-stream append/fsync failures", "errors"),
+                ("crdt_wal_shared_torn_tail_dropped_total",
+                 "Torn final records dropped at recovery",
+                 "torn_dropped")):
+            w.counter(name, help_text, st[key])
+        w.gauge("crdt_wal_shared_size_bytes",
+                "Shared stream size (O(sum of hot tails))",
+                st["size_bytes"])
+        w.gauge("crdt_wal_shared_docs_marked",
+                "Documents with a durable truncation mark",
+                st["docs_marked"])
+        for hname, hkey, htext in (
+                ("crdt_wal_shared_fsync_ms", "fsync_ms",
+                 "Shared fsync latency (the whole round's tax, once)"),
+                ("crdt_wal_shared_covered_docs", "covered_docs",
+                 "Documents covered per shared fsync (the "
+                 "amortization)")):
+            h = st[hkey]
             if h is not None:
-                w.histogram("crdt_wal_fsync_ms",
-                            "WAL fsync latency (the durability tax "
-                            "per sync)",
-                            h["bounds"], h["counts"], h["count"],
-                            h["sum"], {"doc": d.doc_id})
+                w.family(hname, "histogram", htext)
+                w.histogram(hname, htext, h["bounds"], h["counts"],
+                            h["count"], h["sum"])
 
     # -- engine-wide scheduler counters ----------------------------------
     for cname, val in sorted(engine.counters.snapshot().items()):

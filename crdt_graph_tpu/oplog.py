@@ -25,11 +25,14 @@ three physical tiers:
   through a small LRU and pays one ``load_packed_npz`` (typed
   :class:`~crdt_graph_tpu.core.errors.CheckpointError` on a missing or
   corrupt file — never a silent partial log).
-- **checkpoint base** — cold segments that the causal-stability
-  watermark has cleared fold into ONE consolidated base file
-  ("checkpoint advancement"), and the folded segment files are deleted
-  ("segment GC").  Bootstrap then opens base + tail descriptors instead
-  of replaying history (:meth:`OpLog.open_dir`).
+- **chunked checkpoint base** — cold segments that the causal-stability
+  watermark has cleared fold into a SEQUENCE of bounded base chunks
+  ("checkpoint advancement"; ``GRAFT_OPLOG_BASE_CHUNK_OPS``), and the
+  folded segment files are deleted ("segment GC").  A fold appends
+  chunks and rewrites at most the trailing partial one — O(1) chunks of
+  write amplification — and a mid-history catch-up window loads ONLY
+  its covering chunks.  Bootstrap then opens base + tail descriptors
+  instead of replaying history (:meth:`OpLog.open_dir`).
 
 **Reference-stable views.**  Readers never touch the live tier lists:
 :meth:`OpLog.view` freezes the current physical layout into an
@@ -77,6 +80,7 @@ from .codec import packed as packed_mod
 from .codec.packed import DEFAULT_MAX_DEPTH, KIND_ADD, PackedOps
 from .core.errors import CheckpointError
 from .core.operation import Add, Batch, Delete, Operation
+from .utils.hostenv import env_int as _env_int
 from .wal import maybe_crash as _maybe_crash
 
 EMPTY_BATCH_BYTES = b'{"op":"batch","ops":[]}'
@@ -232,8 +236,16 @@ class TierConfig:
     - ``auto_stable`` — single-node mode: everything applied is
       causally stable; the fleet layer disables this and feeds explicit
       watermarks instead.
-    - ``cache_segments`` — loaded-cold-segment LRU capacity
-      (``GRAFT_OPLOG_CACHE_SEGS``).
+    - ``cache_mb`` — byte budget of the LRU shared by spilled
+      segments AND base chunks (``GRAFT_OPLOG_CACHE_MB``, default
+      256) — one sizing knob for everything the cascade pages back
+      in.  ``cache_segments`` (``GRAFT_OPLOG_CACHE_SEGS``) is the
+      legacy entry-count mode, honored ONLY when ``cache_mb=0``.
+    - ``base_chunk_ops`` — checkpoint-base chunk size
+      (``GRAFT_OPLOG_BASE_CHUNK_OPS``): the base is a SEQUENCE of
+      bounded packed-npz chunks, so a mid-history catch-up window
+      opens only its covering chunks (and a fold rewrites at most the
+      last partial chunk, never the whole base).
     - ``ephemeral`` — delete segment files on :meth:`OpLog.close`
       (serving docs spill into a scratch dir; checkpoints don't).
     - ``durable`` — crash-durable mode (docs/DURABILITY.md): segment
@@ -244,38 +256,72 @@ class TierConfig:
     """
 
     __slots__ = ("dir", "hot_ops", "hot_bytes", "gc_min_segs",
-                 "auto_stable", "cache_segments", "ephemeral",
-                 "max_depth", "durable")
+                 "auto_stable", "cache_segments", "cache_mb",
+                 "base_chunk_ops", "ephemeral", "max_depth", "durable")
 
     def __init__(self, dir: str, hot_ops: int = 32768,
                  hot_bytes: int = 0, gc_min_segs: int = 4,
                  auto_stable: bool = True, cache_segments: int = 2,
                  ephemeral: bool = False,
                  max_depth: int = DEFAULT_MAX_DEPTH,
-                 durable: bool = False):
+                 durable: bool = False,
+                 cache_mb: Optional[int] = None,
+                 base_chunk_ops: Optional[int] = None):
         self.dir = dir
         self.hot_ops = max(1, int(hot_ops))
         self.hot_bytes = int(hot_bytes)
         self.gc_min_segs = max(1, int(gc_min_segs))
         self.auto_stable = auto_stable
         self.cache_segments = max(1, int(cache_segments))
+        if cache_mb is None:
+            cache_mb = _env_int("GRAFT_OPLOG_CACHE_MB", 256)
+        self.cache_mb = max(0, int(cache_mb))
+        if base_chunk_ops is None:
+            base_chunk_ops = _env_int("GRAFT_OPLOG_BASE_CHUNK_OPS",
+                                      131072)
+        self.base_chunk_ops = max(1, int(base_chunk_ops))
         self.ephemeral = ephemeral
         self.max_depth = max_depth
         self.durable = durable
 
 
 class _SegCache:
-    """Small LRU of loaded cold-segment columns, shared by a log's
-    descriptors (and by every view pinning them).  Bounded so serving a
-    cold window never accumulates the whole history back into memory;
-    the load-latency histogram is the restore-path telemetry the prom
-    surface exports (``crdt_oplog_segment_load_ms``)."""
+    """Small LRU of loaded cold-segment/base-chunk columns, shared by a
+    log's descriptors (and by every view pinning them).  Bounded so
+    serving a cold window never accumulates the whole history back into
+    memory; the load-latency histogram is the restore-path telemetry
+    the prom surface exports (``crdt_oplog_segment_load_ms``).
 
-    def __init__(self, cap: int):
+    Sizing is BYTE-denominated (``GRAFT_OPLOG_CACHE_MB`` — ONE knob
+    covers spilled segments and the chunked checkpoint base alike):
+    with a byte budget set (the default), entries evict LRU-first
+    once the resident estimate exceeds ``cap_bytes`` and the legacy
+    entry cap is deliberately inert (a 2-entry cap would defeat
+    multi-chunk window caching); only with ``cap_bytes=0``
+    (``GRAFT_OPLOG_CACHE_MB=0``) does the ``cap`` entry count rule,
+    preserving the pre-chunk sizing mode.  Evictions are counted
+    (``crdt_oplog_cache_evictions``) so an operator can see a cache
+    sized below the working set."""
+
+    def __init__(self, cap: int, cap_bytes: int = 0):
         self.cap = cap
+        self.cap_bytes = int(cap_bytes)
         self._mu = threading.Lock()
         self._od: "OrderedDict[str, PackedOps]" = OrderedDict()
+        self._sizes: Dict[str, int] = {}
+        self._bytes = 0
         self.loads = 0
+        self.evictions = 0
+        # per-DIRECTORY counters so logs SHARING this cache can report
+        # their own loads/evictions instead of the engine-wide totals.
+        # Keyed by dirname, not file path: every log's files live in
+        # its own tier dir, so the dicts stay O(live logs) over the
+        # engine's life instead of one entry per segment file ever
+        # loaded (and the per-log series stay monotone across
+        # spill/fold file churn — prometheus counters must never
+        # regress)
+        self._loads_by_dir: Dict[str, int] = {}
+        self._evictions_by_dir: Dict[str, int] = {}
         self._hist = None
 
     def _histogram(self):
@@ -298,11 +344,27 @@ class _SegCache:
         ms = (time.perf_counter() - t0) * 1e3
         with self._mu:
             self.loads += 1
+            d = os.path.dirname(path)
+            self._loads_by_dir[d] = self._loads_by_dir.get(d, 0) + 1
             self._histogram().observe(ms)
+            if path not in self._od:
+                sz = _packed_resident(p)
+                self._sizes[path] = sz
+                self._bytes += sz
             self._od[path] = p
             self._od.move_to_end(path)
-            while len(self._od) > self.cap:
-                self._od.popitem(last=False)
+            # byte budget rules when set (one GRAFT_OPLOG_CACHE_MB
+            # knob across segments and base chunks); the entry count
+            # is the legacy backstop for byte-unbounded caches
+            while len(self._od) > 1 and (
+                    self._bytes > self.cap_bytes if self.cap_bytes
+                    else len(self._od) > self.cap):
+                victim, _ = self._od.popitem(last=False)
+                self._bytes -= self._sizes.pop(victim, 0)
+                self.evictions += 1
+                vd = os.path.dirname(victim)
+                self._evictions_by_dir[vd] = \
+                    self._evictions_by_dir.get(vd, 0) + 1
         return p
 
     def note_load(self, ms: float) -> None:
@@ -312,19 +374,50 @@ class _SegCache:
 
     def drop(self, path: str) -> None:
         with self._mu:
-            self._od.pop(path, None)
+            if self._od.pop(path, None) is not None:
+                self._bytes -= self._sizes.pop(path, 0)
 
     def clear(self) -> None:
         with self._mu:
             self._od.clear()
+            self._sizes.clear()
+            self._bytes = 0
 
     def resident_bytes(self) -> int:
         with self._mu:
-            return sum(_packed_resident(p) for p in self._od.values())
+            return self._bytes
+
+    def resident_bytes_for(self, paths) -> int:
+        """Resident bytes attributable to ``paths`` only — how a log
+        sharing an ENGINE-wide cache reports its own footprint
+        without claiming its neighbors' entries."""
+        with self._mu:
+            return sum(self._sizes.get(p, 0) for p in paths)
+
+    def loads_for_dir(self, dir: str) -> int:
+        """Cache-miss loads attributable to one log's tier dir (same
+        shared-cache honesty rule as :meth:`resident_bytes_for`)."""
+        with self._mu:
+            return self._loads_by_dir.get(dir, 0)
+
+    def evictions_for_dir(self, dir: str) -> int:
+        with self._mu:
+            return self._evictions_by_dir.get(dir, 0)
 
     def hist_export(self) -> Optional[dict]:
         with self._mu:
             return None if self._hist is None else self._hist.export()
+
+
+def make_seg_cache(cache_mb: Optional[int] = None,
+                   cap: int = 2) -> _SegCache:
+    """A segment/chunk LRU an owner can SHARE across many logs —
+    the serving engine builds one per engine so ``GRAFT_OPLOG_CACHE_MB``
+    bounds the whole process's paged-in cold bytes, not 256 MB × docs
+    (pass it via ``enable_tiering(cache=...)``)."""
+    if cache_mb is None:
+        cache_mb = _env_int("GRAFT_OPLOG_CACHE_MB", 256)
+    return _SegCache(cap, cap_bytes=max(0, int(cache_mb)) << 20)
 
 
 class _ColdSeg:
@@ -731,13 +824,25 @@ class OpLog:
         self._mu = threading.RLock()
         self._segs: List[Segment] = []      # hot tail
         self._cold: List[_ColdSeg] = []
-        self._base: Optional[_ColdSeg] = None
+        # checkpoint base as a SEQUENCE of bounded chunks (ascending
+        # .start): a mid-history window opens only covering chunks,
+        # and a fold rewrites at most the last partial chunk
+        self._bases: List[_ColdSeg] = []
+        # persisted-materialization entry carried by the manifest
+        # ({"file", "len"}; engine.TpuTree writes the artifact and
+        # calls note_matz) — dropped whenever a truncate cuts below
+        # its coverage, so a restore can never replay on top of a
+        # state containing rolled-back ops
+        self._matz: Optional[dict] = None
+        self._matz_tombs: List[str] = []
+        self._matz_seq = 0
         self._len = 0
         self._hot_len = 0
         self._tiered_len = 0
         self._last_add: Optional[int] = None
         self._cfg: Optional[TierConfig] = None
         self._cache: Optional[_SegCache] = None
+        self._cache_shared = False
         self._stable: Optional[int] = None
         self._on_spill: Optional[Callable[[], None]] = None
         # durable mode (docs/DURABILITY.md): meta_cb supplies the
@@ -769,7 +874,10 @@ class OpLog:
                        ephemeral: bool = False,
                        max_depth: int = DEFAULT_MAX_DEPTH,
                        on_spill: Optional[Callable[[], None]] = None,
-                       durable: bool = False
+                       durable: bool = False,
+                       cache_mb: Optional[int] = None,
+                       base_chunk_ops: Optional[int] = None,
+                       cache: Optional[_SegCache] = None
                        ) -> "OpLog":
         """Arm the cascade: ops past the hot budget spill to packed-npz
         files under ``dir`` at the next :meth:`maybe_spill`.
@@ -777,7 +885,9 @@ class OpLog:
         when resident columns move to disk.  ``durable`` arms
         crash-durable manifests (TierConfig docstring); wire the
         manifest meta + WAL-truncate callbacks via
-        :meth:`set_durable_hooks`."""
+        :meth:`set_durable_hooks`.  ``cache``: a caller-owned
+        (possibly engine-SHARED) segment LRU (:func:`make_seg_cache`)
+        — the byte budget then bounds every sharing log together."""
         with self._mu:
             os.makedirs(dir, exist_ok=True)
             self._cfg = TierConfig(dir, hot_ops=hot_ops,
@@ -787,9 +897,16 @@ class OpLog:
                                    cache_segments=cache_segments,
                                    ephemeral=ephemeral,
                                    max_depth=max_depth,
-                                   durable=durable)
+                                   durable=durable,
+                                   cache_mb=cache_mb,
+                                   base_chunk_ops=base_chunk_ops)
+            if cache is not None:
+                self._cache = cache
+                self._cache_shared = True
             if self._cache is None:
-                self._cache = _SegCache(self._cfg.cache_segments)
+                self._cache = _SegCache(
+                    self._cfg.cache_segments,
+                    cap_bytes=self._cfg.cache_mb << 20)
             if on_spill is not None:
                 self._on_spill = on_spill
             if auto_stable:
@@ -841,11 +958,19 @@ class OpLog:
         with self._mu:
             cfg = self._cfg
             if cfg is not None and cfg.ephemeral:
-                segs = ([self._base] if self._base else []) \
-                    + self._cold + self._tombs
+                segs = self._bases + self._cold + self._tombs
                 for seg in segs:
                     try:
                         os.remove(seg.path)
+                    except OSError:
+                        pass
+                matz_files = list(self._matz_tombs)
+                if self._matz is not None:
+                    matz_files.append(os.path.join(
+                        cfg.dir, self._matz["file"]))
+                for fp in matz_files:
+                    try:
+                        os.remove(fp)
                     except OSError:
                         pass
                 try:
@@ -853,7 +978,13 @@ class OpLog:
                 except OSError:
                     pass
             if self._cache is not None:
-                self._cache.clear()
+                if self._cache_shared:
+                    # an engine-shared cache outlives this log: drop
+                    # only OUR entries, never the neighbors'
+                    for seg in self._bases + self._cold + self._tombs:
+                        self._cache.drop(seg.path)
+                else:
+                    self._cache.clear()
 
     # -- writers ----------------------------------------------------------
 
@@ -912,8 +1043,17 @@ class OpLog:
             if n >= self._len:
                 return
             n = max(0, n)
+            # a persisted materialization covering rolled-back ops
+            # must never survive the rollback: a restore replaying a
+            # tail on top of it would resurrect the cut ops
+            matz_cut = self._matz is not None \
+                and n < int(self._matz.get("len", 0))
+            if matz_cut:
+                self._drop_matz_locked()
             if n >= self._tiered_len:
                 self._truncate_hot_locked(n - self._tiered_len)
+                if matz_cut:
+                    self._durable_manifest_locked()
             else:
                 self._truncate_tiered_locked(n)
                 # durable mode: the tier layout changed — the manifest
@@ -948,15 +1088,15 @@ class OpLog:
         self._hot_len = keep_hot
 
     def _truncate_tiered_locked(self, n: int) -> None:
-        tiers = ([self._base] if self._base is not None else []) \
-            + self._cold
-        new_base: Optional[_ColdSeg] = None
+        bases = set(map(id, self._bases))
+        tiers = self._bases + self._cold
+        new_bases: List[_ColdSeg] = []
         new_cold: List[_ColdSeg] = []
         hot_seg: Optional[_PackedSeg] = None
         for seg in tiers:
             if seg.start + seg.length <= n:
-                if seg is self._base:
-                    new_base = seg
+                if id(seg) in bases:
+                    new_bases.append(seg)
                 else:
                     new_cold.append(seg)
             elif seg.start < n:
@@ -965,9 +1105,9 @@ class OpLog:
                 self._tombs.append(seg)
             else:
                 self._tombs.append(seg)
-        self._base = new_base
+        self._bases = new_bases
         self._cold = new_cold
-        self._tiered_len = (new_base.length if new_base else 0) \
+        self._tiered_len = sum(cs.length for cs in new_bases) \
             + sum(cs.length for cs in new_cold)
         self._segs = [hot_seg] if hot_seg is not None else []
         self._hot_len = len(hot_seg) if hot_seg is not None else 0
@@ -988,8 +1128,7 @@ class OpLog:
                 if len(idx):
                     self._last_add = g + int(idx[-1])
                     return
-        for seg in reversed(([self._base] if self._base else [])
-                            + self._cold):
+        for seg in reversed(self._bases + self._cold):
             if seg.n_adds:
                 self._last_add = seg.start + int(seg.add_pos.max())
                 return
@@ -1051,6 +1190,68 @@ class OpLog:
             self._meta_cb = meta_cb
             self._on_advance = on_advance
 
+    # -- persisted materialization (engine.TpuTree writes the file) ------
+
+    @property
+    def matz_entry(self) -> Optional[dict]:
+        """The manifest's persisted-materialization entry
+        (``{"file", "len"}``) or None."""
+        with self._mu:
+            return dict(self._matz) if self._matz is not None else None
+
+    def next_matz_name(self) -> str:
+        """A fresh artifact file name (never collides with the live
+        entry, so a crash mid-write can't corrupt a referenced
+        artifact)."""
+        with self._mu:
+            self._matz_seq += 1
+            return f"matz-g{self._matz_seq}.npz"
+
+    def spill_all(self) -> None:
+        """Seal the ENTIRE hot tail into cold segments now (manifest
+        rewritten in durable mode).  The materialization writer calls
+        this first so the artifact's coverage is ≤ the tiered extent —
+        a restore then always finds every covered op in the tiers, and
+        the artifact can never resurrect ops that only ever lived in
+        an unsynced WAL tail."""
+        with self._mu:
+            if self._cfg is None:
+                return
+            if self._hot_len:
+                self._spill_locked(self._hot_len)
+        self._fire_advance()
+        if self._on_spill is not None:
+            try:
+                self._on_spill()
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                pass
+
+    def note_matz(self, file_name: str, length: int) -> None:
+        """Record a freshly written (and fsynced, in durable mode)
+        materialization artifact and publish it atomically in the
+        manifest.  The previous artifact file is deleted only AFTER
+        the manifest stops referencing it."""
+        with self._mu:
+            cfg = self._cfg
+            if cfg is None:
+                raise ValueError("note_matz requires tiering")
+            if length > self._len:
+                raise ValueError(
+                    f"matz covers {length} ops; log holds {self._len}")
+            if self._matz is not None:
+                self._matz_tombs.append(
+                    os.path.join(cfg.dir, self._matz["file"]))
+            self._matz = {"file": file_name, "len": int(length)}
+            if cfg.durable:
+                self._durable_manifest_locked()
+        self._fire_advance()
+
+    def _drop_matz_locked(self) -> None:
+        if self._matz is not None and self._cfg is not None:
+            self._matz_tombs.append(
+                os.path.join(self._cfg.dir, self._matz["file"]))
+        self._matz = None
+
     def _write_manifest_locked(self, target: str, length: int,
                                meta: dict) -> str:
         """Atomically (re)write ``manifest.json`` describing the
@@ -1060,14 +1261,19 @@ class OpLog:
         between the two, proving exactly that)."""
         import json
         manifest = {
-            "version": 1,
+            "version": 2,
             "length": length,
-            "base": ({"file": os.path.basename(self._base.path),
-                      "len": self._base.length}
-                     if self._base is not None else None),
+            # v1 compatibility slot (single-file base); v2 readers use
+            # base_chunks and ignore it
+            "base": None,
+            "base_chunks": [{"file": os.path.basename(cs.path),
+                             "start": cs.start, "len": cs.length}
+                            for cs in self._bases],
             "segments": [{"file": os.path.basename(cs.path),
                           "start": cs.start, "len": cs.length}
                          for cs in self._cold],
+            "matz": dict(self._matz) if self._matz is not None
+            else None,
             "meta": meta,
         }
         path = os.path.join(target, "manifest.json")
@@ -1086,6 +1292,15 @@ class OpLog:
             # POWER loss, not just a process kill
             from .wal import _fsync_dir
             _fsync_dir(target)
+        # superseded materialization artifacts (full paths in the live
+        # dir): unreferenced the moment the rename landed — delete
+        # best-effort
+        tombs, self._matz_tombs = self._matz_tombs, []
+        for fp in tombs:
+            try:
+                os.remove(fp)
+            except OSError:
+                pass
         return path
 
     def _durable_manifest_locked(self) -> None:
@@ -1210,33 +1425,43 @@ class OpLog:
                 break
         if len(fold) < cfg.gc_min_segs:
             return
-        # write-amplification gate: a fold rewrites the whole base, so
-        # only fold once the cleared segments are worth ≥ half of it —
-        # the base then grows geometrically and total rewrite work
-        # stays O(n log n) over the log's life
-        fold_ops = sum(cs.length for cs in fold)
-        if self._base is not None and fold_ops * 2 < self._base.length:
-            return
+        # chunked base: the fold APPENDS bounded chunks — write
+        # amplification is capped at one partial last chunk rewritten
+        # per fold (never the whole base, which the pre-chunk layout
+        # re-copied in full and therefore had to gate at base/2)
+        chunk_ops = cfg.base_chunk_ops
         parts: List[PackedOps] = []
-        if self._base is not None:
-            parts.append(self._base.load(use_cache=False))
+        new_bases = list(self._bases)
+        rewritten: List[_ColdSeg] = []
+        if new_bases and new_bases[-1].length < chunk_ops:
+            # merge the trailing partial chunk with the fold input so
+            # chunks stay densely packed (bounded catch-up reads)
+            tail = new_bases.pop()
+            rewritten.append(tail)
+            parts.append(tail.load(use_cache=False))
         parts.extend(cs.load(use_cache=False) for cs in fold)
         merged = packed_mod.concat_many(parts)
-        self._base_gen += 1
-        path = os.path.join(
-            cfg.dir, f"base-{merged.num_ops:012d}-"
-                     f"g{self._base_gen}.npz")
-        new_base = _ColdSeg.seal(merged, 0, path, self._cache,
-                                 fsync=cfg.durable)
-        # chaos site: the folded base exists on disk but the manifest
-        # still references the old base + segments — which are only
+        start0 = (new_bases[-1].start + new_bases[-1].length) \
+            if new_bases else 0
+        for s in range(0, merged.num_ops, chunk_ops):
+            e = min(s + chunk_ops, merged.num_ops)
+            piece = merged if (s == 0 and e == merged.num_ops) else \
+                packed_mod.select_rows(merged, np.arange(s, e))
+            self._base_gen += 1
+            path = os.path.join(
+                cfg.dir, f"base-{start0 + s:012d}-{e - s}-"
+                         f"g{self._base_gen}.npz")
+            new_bases.append(_ColdSeg.seal(piece, start0 + s, path,
+                                           self._cache,
+                                           fsync=cfg.durable))
+        # chaos site: the folded chunks exist on disk but the manifest
+        # still references the old layout — whose files are only
         # deleted AFTER the manifest write below, so recovery from the
         # old manifest always finds its files
         _maybe_crash("mid-fold")
-        if self._base is not None:
-            self._tombs.append(self._base)
+        self._tombs.extend(rewritten)
         self._tombs.extend(fold)
-        self._base = new_base
+        self._bases = new_bases
         del self._cold[:len(fold)]
         self.compactions += 1
         self.segments_gc += len(fold)
@@ -1270,9 +1495,9 @@ class OpLog:
                      ) -> LogView:
         parts: List[_ViewPart] = []
         g = 0
-        if self._base is not None:
-            parts.append(("cold", self._base, 0, self._base.length, g))
-            g += self._base.length
+        for cs in self._bases:
+            parts.append(("cold", cs, 0, cs.length, g))
+            g += cs.length
         for cs in self._cold:
             parts.append(("cold", cs, 0, cs.length, g))
             g += cs.length
@@ -1308,8 +1533,7 @@ class OpLog:
         merges and coalesced commits append one column segment per
         launch, and full-column re-export cost scales with it."""
         with self._mu:
-            return (1 if self._base is not None else 0) \
-                + len(self._cold) + len(self._segs)
+            return len(self._bases) + len(self._cold) + len(self._segs)
 
     def __bool__(self) -> bool:
         return self._len > 0
@@ -1350,7 +1574,7 @@ class OpLog:
         through the OBJECT api doesn't materialize a million ops the
         caller may never touch; otherwise a plain materialized Batch."""
         with self._mu:
-            if self._base is None and not self._cold \
+            if not self._bases and not self._cold \
                     and len(self._segs) == 1 \
                     and not isinstance(self._segs[0], list):
                 seg = self._segs[0]
@@ -1384,12 +1608,16 @@ class OpLog:
 
     # -- tiered checkpoint (persist / open) --------------------------------
 
-    def persist(self, meta: dict, dir: Optional[str] = None) -> str:
+    def persist(self, meta: dict, dir: Optional[str] = None,
+                matz: Optional[dict] = None) -> str:
         """Tiered checkpoint: spill the remaining hot tail to a final
         segment and write ``manifest.json`` (tier layout + caller
         ``meta``).  Bootstrap then re-opens descriptors
         (:meth:`open_dir`) instead of replaying history.  Requires
-        tiering enabled.
+        tiering enabled.  ``matz`` (``{"file", "len"}``) records a
+        persisted-materialization artifact the caller already wrote
+        into the target dir — the manifest versions it atomically with
+        the tier layout.
 
         With ``dir`` set to somewhere OTHER than the live tier dir,
         the segment files are COPIED there and the manifest written
@@ -1408,12 +1636,41 @@ class OpLog:
             if target != cfg.dir:
                 import shutil
                 os.makedirs(target, exist_ok=True)
-                segs = ([self._base] if self._base is not None
-                        else []) + self._cold
+                segs = self._bases + self._cold
                 for cs in segs:
                     shutil.copyfile(cs.path, os.path.join(
                         target, os.path.basename(cs.path)))
-            return self._write_manifest_locked(target, self._len, meta)
+                if matz is None and self._matz is not None:
+                    # carry the live artifact with the checkpoint
+                    src = os.path.join(cfg.dir, self._matz["file"])
+                    try:
+                        shutil.copyfile(src, os.path.join(
+                            target, self._matz["file"]))
+                        matz = dict(self._matz)
+                    except OSError:
+                        matz = None
+            if matz is not None:
+                if int(matz.get("len", -1)) > self._len:
+                    raise ValueError(
+                        f"matz entry covers {matz.get('len')!r} ops; "
+                        f"log holds {self._len}")
+                if target == cfg.dir:
+                    if self._matz is not None \
+                            and self._matz["file"] != matz["file"]:
+                        self._matz_tombs.append(os.path.join(
+                            cfg.dir, self._matz["file"]))
+                    self._matz = dict(matz)
+            saved = self._matz
+            if target != cfg.dir:
+                # write the foreign manifest against the caller's (or
+                # copied) entry without disturbing the live one
+                self._matz = dict(matz) if matz is not None else None
+            try:
+                return self._write_manifest_locked(target, self._len,
+                                                   meta)
+            finally:
+                if target != cfg.dir:
+                    self._matz = saved
 
     @classmethod
     def open_dir(cls, dir: str, **tier_kw) -> Tuple["OpLog", dict]:
@@ -1428,12 +1685,34 @@ class OpLog:
             with open(path) as f:
                 manifest = json.load(f)
             length = manifest["length"]
-            base_e = manifest["base"]
+            base_e = manifest.get("base")
+            chunk_es = manifest.get("base_chunks")
             seg_es = manifest["segments"]
             if not isinstance(length, int) or isinstance(length, bool):
                 raise ValueError(f"manifest length {length!r}")
             if not isinstance(seg_es, list):
                 raise ValueError("manifest segments not a list")
+            if chunk_es is None:
+                # v1 manifest: a single monolithic base file
+                chunk_es = [] if base_e is None else \
+                    [{"file": base_e["file"], "start": 0,
+                      "len": base_e["len"]}]
+            if not isinstance(chunk_es, list):
+                raise ValueError("manifest base_chunks not a list")
+            # NOTE: matz coverage is deliberately NOT bounded by the
+            # manifest length here — a rollback truncate can shrink
+            # the tiered extent below an artifact the WAL tail still
+            # re-extends past, and an over-covering artifact must
+            # degrade to the lazy first-read fallback (MatzWarning),
+            # never brick the whole restore
+            matz_e = manifest.get("matz")
+            if matz_e is not None and not (
+                    isinstance(matz_e, dict)
+                    and isinstance(matz_e.get("file"), str)
+                    and isinstance(matz_e.get("len"), int)
+                    and not isinstance(matz_e.get("len"), bool)
+                    and matz_e["len"] >= 0):
+                raise ValueError(f"manifest matz entry {matz_e!r}")
         except (OSError, ValueError, TypeError, KeyError,
                 json.JSONDecodeError) as e:
             raise CheckpointError(
@@ -1443,11 +1722,16 @@ class OpLog:
         log.enable_tiering(dir, **tier_kw)
         running = 0
         with log._mu:
-            if base_e is not None:
-                log._base = _ColdSeg.open(
-                    os.path.join(dir, base_e["file"]), 0,
-                    base_e["len"], log._cache)
-                running = base_e["len"]
+            for e in chunk_es:
+                if e["start"] != running:
+                    raise CheckpointError(
+                        f"op-log manifest {path!r}: base chunk "
+                        f"{e['file']!r} starts at {e['start']}, "
+                        f"expected {running}")
+                log._bases.append(_ColdSeg.open(
+                    os.path.join(dir, e["file"]), e["start"],
+                    e["len"], log._cache))
+                running += e["len"]
             for e in seg_es:
                 if e["start"] != running:
                     raise CheckpointError(
@@ -1458,6 +1742,7 @@ class OpLog:
                     os.path.join(dir, e["file"]), e["start"],
                     e["len"], log._cache))
                 running += e["len"]
+            log._matz = dict(matz_e) if matz_e is not None else None
             if running != length:
                 raise CheckpointError(
                     f"op-log manifest {path!r}: tiers hold {running} "
@@ -1478,9 +1763,22 @@ class OpLog:
                 m = _re.match(r"seg-\d+-\d+-(\d+)\.npz$", fn)
                 if m:
                     log._file_seq = max(log._file_seq, int(m.group(1)))
-                m = _re.match(r"base-\d+-g(\d+)\.npz$", fn)
+                m = _re.match(r"base-[0-9-]+-g(\d+)\.npz$", fn)
                 if m:
                     log._base_gen = max(log._base_gen, int(m.group(1)))
+                m = _re.match(r"matz-g(\d+)\.npz$", fn)
+                if m:
+                    log._matz_seq = max(log._matz_seq, int(m.group(1)))
+                    if log._matz is None or fn != log._matz["file"]:
+                        # a stray the manifest never published (crash
+                        # at mid-matz-write, or a superseded artifact
+                        # whose tomb sweep never ran): each is
+                        # O(document state) on disk — delete now, the
+                        # seq counter above already skips past it
+                        try:
+                            os.remove(os.path.join(dir, fn))
+                        except OSError:
+                            pass
         return log, manifest.get("meta", {})
 
     # -- telemetry ---------------------------------------------------------
@@ -1510,37 +1808,49 @@ class OpLog:
         """Counter/gauge snapshot (``crdt_oplog_*`` prom families +
         per-doc ``/metrics`` key).  JSON-safe."""
         with self._mu:
-            tiers = ([self._base] if self._base is not None else []) \
-                + self._cold
+            tiers = self._bases + self._cold
             hot_b = self._hot_bytes_locked()
             idx_b = sum(cs.index_bytes() for cs in tiers)
-            cache_b = self._cache.resident_bytes() \
-                if self._cache is not None else 0
+            if self._cache is None:
+                cache_b = loads = evictions = 0
+            elif self._cache_shared:
+                # own entries/counters only — a shared cache's totals
+                # belong to the engine, not to every doc's series at
+                # once (prom sums over the doc label)
+                own = [cs.path for cs in tiers + self._tombs]
+                cache_b = self._cache.resident_bytes_for(own)
+                loads = self._cache.loads_for_dir(self._cfg.dir)
+                evictions = self._cache.evictions_for_dir(
+                    self._cfg.dir)
+            else:
+                cache_b = self._cache.resident_bytes()
+                loads = self._cache.loads
+                evictions = self._cache.evictions
             return {
                 "tiered": self._cfg is not None,
                 "hot_ops": self._hot_len,
                 "cold_ops": sum(cs.length for cs in self._cold),
-                "base_ops": self._base.length
-                if self._base is not None else 0,
+                "base_ops": sum(cs.length for cs in self._bases),
                 "hot_bytes": hot_b,
                 "index_bytes": idx_b,
                 "cache_bytes": cache_b,
                 "resident_bytes": hot_b + idx_b + cache_b,
                 "cold_file_bytes": sum(cs.file_bytes
                                        for cs in self._cold),
-                "base_file_bytes": self._base.file_bytes
-                if self._base is not None else 0,
+                "base_file_bytes": sum(cs.file_bytes
+                                       for cs in self._bases),
                 "segments": {"hot": len(self._segs),
                              "cold": len(self._cold),
-                             "base": 1 if self._base is not None
-                             else 0},
+                             "base": len(self._bases)},
                 "spills": self.spills,
                 "compactions": self.compactions,
                 "segments_gc": self.segments_gc,
                 "gc_deferred": self.gc_deferred,
-                "segment_loads": self._cache.loads
-                if self._cache is not None else 0,
+                "segment_loads": loads,
+                "cache_evictions": evictions,
                 "load_ms": self._cache.hist_export()
                 if self._cache is not None else None,
                 "stable_mark": self._stable_locked(),
+                "matz_len": int(self._matz["len"])
+                if self._matz is not None else 0,
             }

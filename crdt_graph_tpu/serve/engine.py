@@ -126,8 +126,7 @@ class ServedDoc:
                     hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
                     gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
                     auto_stable=not engine.external_stability,
-                    cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
-                    ephemeral=True)
+                    ephemeral=True, cache=engine.oplog_cache)
         self.queue = DocQueue(max_requests=engine.max_queue_requests,
                               max_leaves=engine.max_queue_leaves)
         self.next_replica = 1
@@ -163,8 +162,8 @@ class ServedDoc:
             hot_bytes=_env_int("GRAFT_OPLOG_HOT_BYTES", 0),
             gc_min_segs=_env_int("GRAFT_OPLOG_GC_SEGS", 4),
             auto_stable=not engine.external_stability,
-            cache_segments=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2),
-            ephemeral=False, durable=True)
+            ephemeral=False, durable=True,
+            cache=engine.oplog_cache)
         if had_manifest:
             self.tree = engine_mod.TpuTree.restore_tiered(
                 ddir, **tier_kw)
@@ -177,14 +176,36 @@ class ServedDoc:
             self.tree._log.set_durable_hooks(
                 self.tree.manifest_meta, self._on_tier_advance)
         if engine.wal_sync != "off":
-            self.wal = wal_mod.Wal(os.path.join(ddir, "wal.log"))
+            perdoc_path = os.path.join(ddir, "wal.log")
+            if engine.shared_wal is not None:
+                if os.path.exists(perdoc_path) \
+                        and os.path.getsize(perdoc_path) > len(
+                            wal_mod.MAGIC):
+                    # a per-doc WAL tail from a pre-GRAFT_WAL_SHARED
+                    # incarnation: only the per-doc format can replay
+                    # it — ignoring it would drop fsync-acked writes
+                    raise wal_mod.WalError(
+                        f"document {self.doc_id!r} holds a non-empty "
+                        f"per-doc WAL but the engine runs the shared "
+                        f"stream; restart without GRAFT_WAL_SHARED "
+                        f"(its acked tail lives only there)")
+                # shared stream: this doc's records were pre-scanned
+                # out of the engine-wide file at engine construction
+                self.wal = wal_mod.DocWalView(
+                    engine.shared_wal, self.doc_id,
+                    engine._shared_replay.pop(self.doc_id, None))
+            else:
+                self.wal = wal_mod.Wal(perdoc_path)
             # raises typed WalError on mid-log corruption — a server
             # must never silently serve a partially replayed log
             self.replay_stats = self.wal.replay_into(
                 self.tree, engine.chunk_ops)
             # replay-time spills noted truncations; nothing is in
-            # flight, so fold them into the file now
+            # flight, so fold them into the file now — and seed the
+            # artifact cadence (the replay just built the mirror, so
+            # the export is cheap here)
             self.wal_mark_durable()
+            self.maybe_write_matz()
         self.recovered = had_manifest or bool(
             (self.replay_stats or {}).get("records"))
         self.epoch = wal_mod.bump_epoch(ddir)
@@ -202,10 +223,42 @@ class ServedDoc:
         """Everything in the log is now fsync-durable (tiers ∪ synced
         WAL) and no rollback is possible — safe to drop the WAL prefix
         the tiers cover.  Called by the scheduler after each
-        successful fsync, and once after recovery replay."""
+        successful fsync, and once after recovery replay.  A FAILED
+        truncation (tmp-rewrite ENOSPC mid-compaction) is deferred and
+        retried at the next barrier — the covered commits are already
+        durable, so it must never surface as their error."""
         if self.wal is not None and self._wal_truncate_pending:
+            try:
+                self.wal.truncate_below(self.tree._log.tiered_extent)
+            except OSError:
+                self._engine.counters.add("wal_truncate_errors")
+                return              # keep the pending flag; retry
             self._wal_truncate_pending = False
-            self.wal.truncate_below(self.tree._log.tiered_extent)
+
+    def maybe_write_matz(self) -> None:
+        """Refresh the materialization artifact once the log has grown
+        ``GRAFT_MATZ_TAIL_OPS`` past the last one (restore-side tail
+        replay stays bounded by this cadence).  Called by the
+        scheduler at the END of a round — AFTER every ticket resolved
+        (the commit is already durable; an O(document) artifact
+        export must never sit between a client and its ack) — and
+        once after recovery replay.  Skips silently when the mirror
+        is not cheaply derivable — the artifact is an accelerator,
+        never a new cold-path cost on the commit path."""
+        if self.wal is None or self._engine.matz_tail_ops <= 0 \
+                or not engine_mod.matz_enabled():
+            return
+        log = self.tree._log
+        if not log.tiering_enabled:
+            return
+        entry = log.matz_entry
+        covered = int(entry["len"]) if entry is not None else 0
+        if self.tree.log_length - covered < self._engine.matz_tail_ops:
+            return
+        # the artifact write spills the whole hot tail first; the WAL
+        # prefix the new manifest covers drops at the next barrier
+        # (the usual deferred-truncation rule)
+        self.tree.write_matz()
 
     # -- snapshot publication (scheduler thread only) ---------------------
 
@@ -290,6 +343,7 @@ class ServedDoc:
 
     def metrics(self) -> Dict:
         snap = self._snap
+        oplog_tele = self.tree._log.telemetry()
         return {
             "ops_merged": self.ops_merged,
             "dup_absorbed": self.dup_absorbed,
@@ -309,12 +363,17 @@ class ServedDoc:
             "commit_latency_ms": self.commit_ms.snapshot(),
             "coalesce_width": self.coalesce_width.snapshot(),
             # cascade op-log tier state (oplog.py; docs/OPLOG.md)
-            "oplog": self.tree._log.telemetry(),
+            "oplog": oplog_tele,
             # crash durability (wal.py; docs/DURABILITY.md)
             "durable": self._engine.durable_dir is not None,
             "epoch": self.epoch,
             "recovered": self.recovered,
             "wal": None if self.wal is None else self.wal.telemetry(),
+            # persisted materialization (docs/DURABILITY.md §Cold
+            # paths): artifact writes/loads/fallbacks + coverage
+            "matz": dict(self.tree.matz_stats,
+                         len=oplog_tele["matz_len"])
+            if self._engine.durable_dir is not None else None,
         }
 
 
@@ -335,6 +394,7 @@ class ServingEngine:
                  oplog_dir: Optional[str] = None,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
+                 wal_shared: Optional[bool] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
                  start: bool = True):
@@ -360,6 +420,49 @@ class ServingEngine:
         if self.wal_sync not in wal_mod.SYNC_MODES:
             raise ValueError(f"wal_sync {self.wal_sync!r} not in "
                              f"{wal_mod.SYNC_MODES}")
+        # shared group-commit WAL (GRAFT_WAL_SHARED; docs/DURABILITY.md
+        # §Shared WAL): every durable document's records multiplex into
+        # ONE per-engine stream and one fsync per scheduler round
+        # covers all of them — a many-doc fleet stops paying one fsync
+        # stream per document.  Recovery pre-scans the stream once and
+        # hands each document its own record list.
+        if wal_shared is None:
+            wal_shared = os.environ.get(
+                "GRAFT_WAL_SHARED", "0").strip() not in ("", "0")
+        self.shared_wal: Optional[wal_mod.SharedWal] = None
+        self._shared_replay: Dict[str, list] = {}
+        if self.durable_dir is not None and self.wal_sync != "off":
+            os.makedirs(self.durable_dir, exist_ok=True)
+            shared_path = os.path.join(self.durable_dir,
+                                       "wal-shared.log")
+            if wal_shared:
+                self.shared_wal = wal_mod.SharedWal(shared_path)
+                # raises typed WalError on mid-log corruption — never
+                # a silent partial recovery
+                self._shared_replay = self.shared_wal.recover_records()
+            elif os.path.exists(shared_path) \
+                    and os.path.getsize(shared_path) > len(
+                        wal_mod.SHARED_MAGIC):
+                # the previous incarnation ran GRAFT_WAL_SHARED and
+                # left records only this format can replay — silently
+                # ignoring them would drop fsync-acked writes
+                raise wal_mod.WalError(
+                    f"durable dir {self.durable_dir!r} holds a "
+                    f"non-empty shared WAL stream but this engine "
+                    f"was started without GRAFT_WAL_SHARED; restart "
+                    f"with the previous mode (its acked tail lives "
+                    f"only there)")
+        # persisted-materialization cadence (docs/DURABILITY.md §Cold
+        # paths): once a durable doc's log grows this far past its
+        # artifact, the next round-end refresh rewrites it (0 = off)
+        self.matz_tail_ops = _env_int("GRAFT_MATZ_TAIL_OPS", 65536)
+        # ONE segment/chunk LRU for the whole engine: the
+        # GRAFT_OPLOG_CACHE_MB byte budget bounds every served doc's
+        # paged-in cold bytes TOGETHER (a per-doc budget would admit
+        # 256 MB × docs resident on a many-doc node)
+        from ..oplog import make_seg_cache
+        self.oplog_cache = make_seg_cache(
+            cap=_env_int("GRAFT_OPLOG_CACHE_SEGS", 2))
         self._own_oplog_dir = False
         self.oplog_dir = oplog_dir or os.environ.get("GRAFT_OPLOG_DIR")
         if self.oplog_hot_ops > 0 and self.oplog_dir is None \
@@ -570,6 +673,11 @@ class ServingEngine:
                 "fingerprint": snap.fingerprint(),
                 "audit": audit,
                 "error": ct.error,
+                # persisted materialization: did the recovered doc's
+                # first read come off the artifact?  (None for
+                # non-recovered/non-durable docs)
+                "matz_hit": (doc.tree.matz_stats["loads"] > 0)
+                if doc.recovered else None,
             })
         except Exception:            # noqa: BLE001 — recorder boundary
             self.counters.add("flight_record_errors")
@@ -622,6 +730,8 @@ class ServingEngine:
                 pass
             if d.wal is not None:
                 d.wal.close()
+        if self.shared_wal is not None:
+            self.shared_wal.close()
         if self._own_oplog_dir:
             import shutil
             shutil.rmtree(self.oplog_dir, ignore_errors=True)

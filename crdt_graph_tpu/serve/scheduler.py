@@ -296,6 +296,19 @@ class MergeScheduler(threading.Thread):
         for items in grouped_runs:
             self._process_grouped(items)
         self._finish_wal_round()
+        # persisted-materialization refresh LAST: every ticket above
+        # has resolved, so the O(document) artifact export (spill-all
+        # + mirror dump, ServedDoc.maybe_write_matz) never sits
+        # between a client and its ack — it only delays the next
+        # round's drain, bounded by the GRAFT_MATZ_TAIL_OPS cadence
+        for item in work:
+            try:
+                item[0].maybe_write_matz()
+            except Exception:   # noqa: BLE001 — the artifact is an
+                # accelerator; a failed export (disk full mid-dump)
+                # must not take down the round loop.  CrashPoint is a
+                # BaseException and still propagates (chaos harness).
+                self.engine.counters.add("matz_write_errors")
 
     def _guarded(self, fn, item: _WorkItem, *args) -> None:
         """Run one document's commit; a non-CRDT failure is recorded on
@@ -527,8 +540,21 @@ class MergeScheduler(threading.Thread):
         ack (fsyncs are per-doc files; a cross-doc barrier would add
         latency without saving a single call).  fsync latency is
         billed into each commit's ``wal_fsync`` stage (the flight
-        recorder's view of the durability tax)."""
+        recorder's view of the durability tax).
+
+        SHARED-stream mode (engine.shared_wal): every document's
+        records landed in ONE file, so here the barrier really is one
+        ``fsync`` covering all of them — fsyncs/round collapses from
+        O(docs touched) to 1 at the same fsync-before-ack durability
+        point, and per-doc resolution follows the single call (no
+        added coupling: the call they all wait on IS the one call
+        made)."""
         pending, self._wal_round = self._wal_round, []
+        if not pending:
+            return
+        if self.engine.shared_wal is not None:
+            self._finish_wal_round_shared(pending)
+            return
         for doc, tickets, ct, publish_needed in pending:
             wal_mod.maybe_crash("ack-pre-fsync")
             t0 = time.perf_counter()
@@ -553,6 +579,44 @@ class MergeScheduler(threading.Thread):
             ct.total_ms = round(
                 ct.total_ms + ms
                 + (time.perf_counter() - t0) * 1e3, 3)
+            doc.commit_ms.observe(ct.total_ms)
+            self.engine.record_commit(doc, ct)
+
+    def _finish_wal_round_shared(self, pending: List[tuple]) -> None:
+        """Shared-stream barrier: one fsync, then per-doc durable
+        marks, publishes, and ticket resolution.  A failed fsync
+        sheds and rolls back EVERY commit it covered — their records
+        share the dropped unsynced tail, exactly the per-doc rule
+        applied once."""
+        wal_mod.maybe_crash("ack-pre-fsync")
+        shared = self.engine.shared_wal
+        t0 = time.perf_counter()
+        try:
+            shared.sync(covered_docs=len(pending))
+        except OSError as e:
+            for doc, tickets, ct, _ in pending:
+                self._wal_shed(doc, tickets, ct, e)
+                self.engine.record_commit(doc, ct)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        wal_mod.maybe_crash("post-fsync-pre-publish")
+        self.engine.counters.add("wal_shared_rounds")
+        self.engine.counters.add("wal_shared_covered_docs",
+                                 len(pending))
+        for doc, tickets, ct, publish_needed in pending:
+            doc.wal_mark_durable()
+            ct.stages_ms["wal_fsync"] = round(
+                ct.stages_ms.get("wal_fsync", 0.0) + ms, 3)
+            t1 = time.perf_counter()
+            if publish_needed:
+                with ct.stage("publish"):
+                    ct.staleness_s = doc.publish()
+            for t in tickets:
+                t.done.set()
+            ct.wal_deferred = False
+            ct.total_ms = round(
+                ct.total_ms + ms
+                + (time.perf_counter() - t1) * 1e3, 3)
             doc.commit_ms.observe(ct.total_ms)
             self.engine.record_commit(doc, ct)
 

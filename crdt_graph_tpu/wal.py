@@ -68,9 +68,11 @@ import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-MAGIC = b"GRAFTWAL"          # 8 bytes; file format v1
+MAGIC = b"GRAFTWAL"          # 8 bytes; file format v1 (per-doc)
+SHARED_MAGIC = b"GRAFTWLX"   # 8 bytes; shared-stream format v1
 _HDR = struct.Struct("<II")  # payload_len, crc32(payload)
 _POS = struct.Struct(">Q")   # end_pos, first 8 payload bytes
+_DOC = struct.Struct(">H")   # doc-id length, first 2 shared-payload bytes
 
 # a record length beyond this is garbage, not a record (the serving
 # layer caps request bodies at 128 MB; columns add < 2x)
@@ -78,7 +80,7 @@ MAX_RECORD_BYTES = 1 << 30
 
 # the deterministic kill sites (docs/DURABILITY.md §Crash-point matrix)
 CRASH_SITES = ("ack-pre-fsync", "post-fsync-pre-publish", "mid-spill",
-               "mid-fold", "mid-manifest-write")
+               "mid-fold", "mid-manifest-write", "mid-matz-write")
 
 SYNC_MODES = ("commit", "batch", "off")
 
@@ -158,12 +160,60 @@ def _decode_payload(payload: bytes) -> Tuple[int, Any]:
     return end_pos, p
 
 
-def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
-    """Parse a WAL file into ``(records, torn_dropped, good_bytes)``
-    without decoding payloads: each record is ``(offset, end_pos,
-    payload)``.  Implements the corruption taxonomy from the module
-    docstring — torn tail tolerated and counted, mid-log corruption a
-    typed :class:`WalError`.  A missing file is an empty log."""
+def _encode_shared_payload(doc_id: str, p, end_pos: int) -> bytes:
+    """One commit's applied ops as a SHARED-stream record payload:
+    ``u16 doc_id_len | doc_id utf8 | u64 end_pos | packed npz`` — the
+    doc id rides the record header so one file can carry every
+    document's group commits (docs/DURABILITY.md §Shared WAL)."""
+    from . import engine as engine_mod
+    did = doc_id.encode()
+    if len(did) > 0xFFFF:
+        raise ValueError(f"doc id too long for the WAL header: "
+                         f"{doc_id[:64]!r}…")
+    buf = io.BytesIO()
+    buf.write(_DOC.pack(len(did)))
+    buf.write(did)
+    buf.write(_POS.pack(end_pos))
+    engine_mod.write_packed_npz(
+        buf, p, {"num_ops": p.num_ops,
+                 "hints_vouched": bool(p.hints_vouched)},
+        compress=False)
+    return buf.getvalue()
+
+
+def _shared_header(payload: bytes) -> Tuple[str, int]:
+    """Decode ``(doc_id, end_pos)`` from a shared payload without
+    touching the npz blob (the scan/truncation path)."""
+    dlen = _DOC.unpack_from(payload)[0]
+    hdr_end = _DOC.size + dlen
+    if len(payload) < hdr_end + _POS.size:
+        raise ValueError("shared payload shorter than its header")
+    doc_id = payload[_DOC.size:hdr_end].decode()
+    return doc_id, _POS.unpack_from(payload, hdr_end)[0]
+
+
+def _decode_shared_payload(payload: bytes) -> Tuple[str, int, Any]:
+    """Inverse of :func:`_encode_shared_payload` →
+    ``(doc_id, end_pos, PackedOps)``."""
+    from .codec import packed as packed_mod
+    from .core.errors import CheckpointError
+    doc_id, end_pos = _shared_header(payload)
+    blob_off = _DOC.size + len(doc_id.encode()) + _POS.size
+    try:
+        p, _ = packed_mod.load_packed_npz(io.BytesIO(payload[blob_off:]))
+    except CheckpointError as e:
+        raise WalError(f"crc-valid shared WAL record failed to "
+                       f"decode: {e}") from e
+    return doc_id, end_pos, p
+
+
+def _scan_raw(path: str, magic: bytes
+              ) -> Tuple[List[Tuple[int, bytes]], int, int]:
+    """Shared record-framing scan: ``(records, torn_dropped,
+    good_bytes)`` with each record ``(offset, payload)``.  The
+    corruption taxonomy from the module docstring — torn tail
+    tolerated and counted, mid-log corruption a typed
+    :class:`WalError`.  A missing file is an empty log."""
     try:
         with open(path, "rb") as f:
             data = f.read()
@@ -171,11 +221,11 @@ def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
         return [], 0, 0
     if not data:
         return [], 0, 0
-    if data[:len(MAGIC)] != MAGIC:
+    if data[:len(magic)] != magic:
         raise WalError(f"WAL {path!r}: bad magic "
-                       f"{data[:len(MAGIC)]!r}")
-    records: List[Tuple[int, int, bytes]] = []
-    off = len(MAGIC)
+                       f"{data[:len(magic)]!r}")
+    records: List[Tuple[int, bytes]] = []
+    off = len(magic)
     n = len(data)
     while off < n:
         if n - off < _HDR.size:
@@ -194,15 +244,50 @@ def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
                 f"WAL {path!r}: checksum mismatch at offset {off} "
                 f"with {n - end} valid bytes beyond it — mid-log "
                 f"corruption, refusing a partial replay")
-        records.append((off, _POS.unpack_from(payload)[0], payload))
+        records.append((off, payload))
         off = end
     return records, 0, off
+
+
+def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
+    """Parse a per-doc WAL file into ``(records, torn_dropped,
+    good_bytes)`` without decoding payloads: each record is
+    ``(offset, end_pos, payload)``."""
+    raw, torn, good = _scan_raw(path, MAGIC)
+    return [(off, _POS.unpack_from(payload)[0], payload)
+            for off, payload in raw], torn, good
+
+
+def scan_shared(path: str
+                ) -> Tuple[List[Tuple[int, str, int, bytes]], int, int]:
+    """Parse a shared-stream WAL into ``(records, torn_dropped,
+    good_bytes)``: each record is ``(offset, doc_id, end_pos,
+    payload)`` with the doc id decoded from the record header and
+    ``payload`` still carrying the full shared framing (feed it to
+    :func:`_decode_shared_payload` for the columns)."""
+    raw, torn, good = _scan_raw(path, SHARED_MAGIC)
+    out: List[Tuple[int, str, int, bytes]] = []
+    for off, payload in raw:
+        try:
+            doc_id, end_pos = _shared_header(payload)
+        except (struct.error, UnicodeDecodeError, ValueError) as e:
+            raise WalError(
+                f"shared WAL {path!r}: crc-valid record at offset "
+                f"{off} has an unreadable doc header: {e}") from e
+        out.append((off, doc_id, end_pos, payload))
+    return out, torn, good
 
 
 class Wal:
     """One document's write-ahead log.  Appends and fsyncs come from
     the scheduler thread; truncation may come from the anti-entropy
-    thread (watermark GC) — a lock serializes the file handle."""
+    thread (watermark GC) — a lock serializes the file handle.
+
+    NOTE: :class:`SharedWal` carries the SAME append/sync/repair
+    error-path contract (failed-append repair to the last record
+    boundary, failed-fsync drop of the whole unsynced tail) over its
+    own framing — a semantic fix here almost certainly applies there
+    too; the crash matrix runs both."""
 
     def __init__(self, path: str):
         self.path = path
@@ -222,6 +307,7 @@ class Wal:
         self._fsync_hist = None
         self._size = 0          # last good RECORD boundary
         self._synced_size = 0   # last fsync-durable boundary
+        self._opened_once = False
         self._dirty = False     # a failed write left untracked bytes
 
     def _histogram(self):
@@ -240,7 +326,18 @@ class Wal:
                 self._f.flush()
                 _fsync_dir(os.path.dirname(self.path))
             self._size = self._f.tell()
-            self._synced_size = self._size
+            if not self._opened_once:
+                # FIRST open: the pre-existing content is the trusted
+                # durable baseline (a previous incarnation's log)
+                self._synced_size = self._size
+                self._opened_once = True
+            else:
+                # REOPEN after a repair closed the handle: bytes past
+                # the last fsync barrier are NOT durable — resetting
+                # the barrier here would let a later failed sync keep
+                # an unsynced record whose commit was shed (the
+                # clean-prefix-of-acked contract)
+                self._synced_size = min(self._synced_size, self._size)
         return self._f
 
     def _repair_locked(self, to_size: int) -> None:
@@ -451,6 +548,408 @@ class Wal:
             "replay_skipped": self.replay_skipped,
             "torn_dropped": self.torn_dropped,
             "size_bytes": self.size_bytes(),
+        }
+
+
+class SharedWal:
+    """ONE write-ahead stream for a whole engine's documents
+    (``GRAFT_WAL_SHARED=1``; docs/DURABILITY.md §Shared WAL).
+
+    A many-doc durable fleet under per-doc WALs burns one fsync stream
+    per document per scheduler round; here every document's commit
+    records append to a single file (doc id in the record header) and
+    ONE fsync per round makes all of them durable — the scheduler
+    resolves every covered document's tickets right after that single
+    barrier, so fsyncs/round is O(1) instead of O(docs touched) at
+    exactly the same durability point (fsync-before-ack).
+
+    Per-doc truncation becomes per-doc DURABLE MARKS: a document's
+    spill/fold advances its mark, and compaction rewrites the stream
+    dropping records every owner's tiers already cover (atomic
+    tmp+fsync+rename, same recipe as ``Wal.truncate_below``), so
+    steady-state size is O(sum of hot tails).
+
+    Thread model: appends/fsyncs from the scheduler thread, marks from
+    scheduler or anti-entropy threads — one lock serializes the file,
+    exactly like :class:`Wal`.  The append/sync/repair error paths
+    deliberately mirror :class:`Wal`'s clause for clause (same
+    fsyncgate contract, different framing) — keep them in sync; the
+    crash matrix runs both."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mu = threading.Lock()
+        self._f: Optional[Any] = None
+        self._marks: Dict[str, int] = {}
+        # telemetry (crdt_wal_shared_* prom families)
+        self.appends = 0
+        self.appended_bytes = 0
+        self.fsyncs = 0
+        self.sync_rounds = 0
+        self.compactions = 0
+        self.errors = 0
+        self.repairs = 0
+        self.torn_dropped = 0
+        self._covered_hist = None
+        self._fsync_hist = None
+        self._size = 0
+        self._synced_size = 0
+        self._last_compact_size = 0
+        self._opened_once = False
+        self._dirty = False
+
+    def _histogram(self, which: str):
+        from .serve.metrics import (LATENCY_BOUNDS_MS, WIDTH_BOUNDS,
+                                    Histogram)
+        if which == "fsync":
+            if self._fsync_hist is None:
+                self._fsync_hist = Histogram(LATENCY_BOUNDS_MS)
+            return self._fsync_hist
+        if self._covered_hist is None:
+            self._covered_hist = Histogram(WIDTH_BOUNDS)
+        return self._covered_hist
+
+    def _open_locked(self):
+        if self._f is None:
+            new = not os.path.exists(self.path) \
+                or os.path.getsize(self.path) == 0
+            self._f = open(self.path, "ab")
+            if new:
+                self._f.write(SHARED_MAGIC)
+                self._f.flush()
+                _fsync_dir(os.path.dirname(self.path))
+            self._size = self._f.tell()
+            if not self._opened_once:
+                # first open trusts pre-existing content; a REOPEN
+                # after a repair must NOT promote the unsynced tail
+                # to durable (same contract as Wal._open_locked)
+                self._synced_size = self._size
+                self._opened_once = True
+            else:
+                self._synced_size = min(self._synced_size, self._size)
+        return self._f
+
+    def _repair_locked(self, to_size: int) -> None:
+        """Same contract as ``Wal._repair_locked``: a failed
+        write/fsync must never leave partial bytes that a later
+        success would bury mid-log."""
+        try:
+            if self._f is not None:
+                self._f.close()
+        except OSError:
+            pass
+        self._f = None
+        try:
+            with open(self.path, "rb+") as f:
+                f.truncate(to_size)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            self._dirty = True
+            return
+        self._size = to_size
+        self._synced_size = min(self._synced_size, to_size)
+        self._dirty = False
+        self.repairs += 1
+
+    # -- write path -------------------------------------------------------
+
+    def append(self, doc_id: str, p, end_pos: int) -> None:
+        """Buffer one document's commit record.  OSError semantics are
+        the per-doc WAL's: raised to the scheduler, which rolls back
+        and sheds THAT commit (other documents' already-appended
+        records this round stay intact — the repair truncates only
+        the failed append's partial bytes)."""
+        payload = _encode_shared_payload(doc_id, p, end_pos)
+        rec = _HDR.pack(len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._mu:
+            if self._dirty:
+                self._repair_locked(self._size)
+                if self._dirty:
+                    self.errors += 1
+                    raise OSError(
+                        f"shared WAL {self.path!r} needs repair after "
+                        f"a failed write and the disk still refuses")
+            try:
+                f = self._open_locked()
+                f.write(rec)
+                f.flush()
+            except OSError:
+                self.errors += 1
+                self._repair_locked(self._size)
+                raise
+            self.appends += 1
+            self.appended_bytes += len(rec)
+            self._size += len(rec)
+
+    def sync(self, covered_docs: int = 1) -> None:
+        """THE round barrier: one fsync makes every record appended
+        since the last sync durable, across all documents
+        (``covered_docs`` feeds the amortization histogram).  Failure
+        drops the whole unsynced tail — every covered commit is being
+        shed and rolled back, and a post-error page cache is
+        untrustworthy (same fsyncgate rule as the per-doc WAL)."""
+        import time
+        with self._mu:
+            try:
+                f = self._open_locked()
+                t0 = time.perf_counter()
+                os.fsync(f.fileno())
+            except OSError:
+                self.errors += 1
+                self._repair_locked(self._synced_size)
+                raise
+            self._synced_size = self._size
+            self.fsyncs += 1
+            self.sync_rounds += 1
+            self._histogram("fsync").observe(
+                (time.perf_counter() - t0) * 1e3)
+            self._histogram("covered").observe(max(1, covered_docs))
+
+    # -- per-doc durable marks + compaction -------------------------------
+
+    def mark_durable(self, doc_id: str, pos: int) -> int:
+        """Document ``doc_id``'s tiers now cover rows below ``pos``:
+        its records at or below are dead weight.  The mark itself is
+        O(1); the stream compacts (atomic rewrite dropping every
+        doc's covered records) only once it has grown past
+        max(1 MB, 2× its size after the last compaction) — a full
+        rewrite per mark would re-read and re-CRC every document's
+        records on the scheduler thread at every spill (per-doc mode
+        paid O(own file); amortized doubling keeps the shared cost
+        O(1) per appended byte).  Returns records dropped (0 when
+        compaction deferred)."""
+        with self._mu:
+            self._marks[doc_id] = max(
+                self._marks.get(doc_id, 0), int(pos))
+            if self._f is None and self._size == 0:
+                # recovery-time marks arrive before the first append
+                # opens the file: size up the on-disk stream or a big
+                # dead log would defer compaction forever
+                try:
+                    self._size = os.path.getsize(self.path)
+                except OSError:
+                    pass
+            if self._size < max(1 << 20, 2 * self._last_compact_size):
+                return 0
+            return self._compact_locked()
+
+    def compact(self) -> int:
+        """Force a stream compaction now (tests / shutdown hygiene)."""
+        with self._mu:
+            return self._compact_locked()
+
+    def _compact_locked(self) -> int:
+        if self._f is not None:
+            self._f.flush()
+        try:
+            records, torn, _ = scan_shared(self.path)
+        except WalError:
+            self.errors += 1
+            return 0
+        keep = [r for r in records
+                if r[2] > self._marks.get(r[1], -1)]
+        if len(keep) == len(records) and not torn:
+            self._last_compact_size = self._size
+            return 0
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(SHARED_MAGIC)
+            for _, _, _, payload in keep:
+                f.write(_HDR.pack(
+                    len(payload),
+                    zlib.crc32(payload) & 0xFFFFFFFF))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        os.replace(tmp, self.path)
+        _fsync_dir(os.path.dirname(self.path))
+        self._size = os.path.getsize(self.path)
+        self._synced_size = self._size
+        self._last_compact_size = self._size
+        self._dirty = False
+        self.compactions += 1
+        return len(records) - len(keep)
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover_records(self) -> Dict[str, List[Tuple[int, bytes]]]:
+        """One startup scan partitioning the stream per document:
+        ``{doc_id: [(end_pos, payload), ...]}`` in append order.  A
+        torn final record is dropped (on disk too, so the next append
+        starts at a clean boundary) and counted; mid-log corruption
+        raises :class:`WalError`."""
+        with self._mu:
+            records, torn, good = scan_shared(self.path)
+            if torn:
+                self.torn_dropped += torn
+                try:
+                    with open(self.path, "rb+") as f:
+                        f.truncate(good)
+                        f.flush()
+                        os.fsync(f.fileno())
+                except OSError:
+                    self.errors += 1
+            # seed the size bookkeeping from the scan so recovery-time
+            # durable marks can trigger compaction of a big dead
+            # stream (the file hasn't been opened for append yet)
+            if good:
+                self._size = good
+                self._synced_size = good
+                self._opened_once = True
+            out: Dict[str, List[Tuple[int, bytes]]] = {}
+            for _, doc_id, end_pos, payload in records:
+                out.setdefault(doc_id, []).append((end_pos, payload))
+            return out
+
+    # -- lifecycle / telemetry ---------------------------------------------
+
+    def size_bytes(self) -> int:
+        with self._mu:
+            if self._f is not None:
+                return self._size
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        with self._mu:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                except OSError:
+                    self.errors += 1
+                self._f = None
+
+    def telemetry(self) -> Dict:
+        with self._mu:
+            fh = None if self._fsync_hist is None \
+                else self._fsync_hist.export()
+            ch = None if self._covered_hist is None \
+                else self._covered_hist.export()
+        return {
+            "appends": self.appends,
+            "appended_bytes": self.appended_bytes,
+            "fsyncs": self.fsyncs,
+            "sync_rounds": self.sync_rounds,
+            "fsync_ms": fh,
+            "covered_docs": ch,
+            "compactions": self.compactions,
+            "errors": self.errors,
+            "repairs": self.repairs,
+            "torn_dropped": self.torn_dropped,
+            "size_bytes": self.size_bytes(),
+            "docs_marked": len(self._marks),
+        }
+
+
+class DocWalView:
+    """One document's facade over the engine's :class:`SharedWal` —
+    the surface the scheduler and ``ServedDoc`` already speak
+    (``append``/``sync``/``truncate_below``/``replay_into``/
+    ``telemetry``), so shared mode slots in without forking the
+    commit path.  ``sync`` fsyncs the SHARED stream (commit-mode
+    callers); in batch mode the scheduler skips the per-doc facade
+    and drives one ``SharedWal.sync`` per round directly."""
+
+    def __init__(self, shared: SharedWal, doc_id: str,
+                 records: Optional[List[Tuple[int, bytes]]] = None):
+        self.shared = shared
+        self.doc_id = doc_id
+        self._records = records or []
+        # per-doc telemetry (the shared stream's counters aggregate
+        # every document; these keep /metrics per-doc keys honest)
+        self.appends = 0
+        self.appended_bytes = 0
+        self.truncations = 0
+        self.replay_records = 0
+        self.replay_ops = 0
+        self.replay_skipped = 0
+        self.torn_dropped = 0
+
+    def append(self, p, end_pos: int) -> None:
+        # appends come from the single scheduler thread, so the
+        # before/after delta attributes this record's bytes to THIS
+        # doc without new plumbing in the shared append path
+        b0 = self.shared.appended_bytes
+        self.shared.append(self.doc_id, p, end_pos)
+        self.appends += 1
+        self.appended_bytes += self.shared.appended_bytes - b0
+
+    def sync(self) -> None:
+        self.shared.sync(covered_docs=1)
+
+    def truncate_below(self, pos: int) -> int:
+        dropped = self.shared.mark_durable(self.doc_id, pos)
+        self.truncations += 1
+        return dropped
+
+    def replay_into(self, tree, chunk_ops: int = 1 << 17) -> Dict:
+        """Re-apply this document's pre-scanned shared records (same
+        semantics as ``Wal.replay_into``: records at or below the
+        restored extent skip, overlaps dup-absorb, a record that
+        fails to re-apply is typed acked loss)."""
+        from .core.errors import CRDTError
+        base_len = tree.log_length
+        applied = 0
+        for end_pos, payload in self._records:
+            if end_pos <= base_len:
+                self.replay_skipped += 1
+                continue
+            _, _, p = _decode_shared_payload(payload)
+            try:
+                tree.apply_packed_chunked(p, chunk_ops)
+            except CRDTError as e:
+                raise WalError(
+                    f"shared WAL record for {self.doc_id!r} "
+                    f"(end_pos {end_pos}) failed to re-apply during "
+                    f"recovery: {e!r}") from e
+            self.replay_records += 1
+            self.replay_ops += p.num_ops
+            applied += int(tree.last_applied_mask.sum()) \
+                if tree.last_applied_mask is not None else 0
+        self._records = []      # replayed once; don't pin the payloads
+        return {"records": self.replay_records,
+                "ops": self.replay_ops,
+                "applied": applied,
+                "skipped": self.replay_skipped,
+                "torn_dropped": 0,
+                "base_len": base_len,
+                "log_len": tree.log_length}
+
+    def size_bytes(self) -> int:
+        return self.shared.size_bytes()
+
+    def close(self) -> None:
+        pass                    # the engine owns the shared stream
+
+    def telemetry(self) -> Dict:
+        """Per-doc keys (`appends`/`appended_bytes`/`truncations`/
+        `replay_*`) are genuinely this document's; `fsyncs`/`fsync_ms`/
+        `errors`/`repairs`/`size_bytes` describe the WHOLE shared
+        stream (marked by `shared: true`) — the prom surface renders
+        those once under `crdt_wal_shared_*` instead of per doc."""
+        sh = self.shared.telemetry()
+        return {
+            "shared": True,
+            "appends": self.appends,
+            "appended_bytes": self.appended_bytes,
+            "fsyncs": sh["fsyncs"],
+            "fsync_ms": sh["fsync_ms"],
+            "truncations": self.truncations,
+            "errors": sh["errors"],
+            "repairs": sh["repairs"],
+            "replay_records": self.replay_records,
+            "replay_ops": self.replay_ops,
+            "replay_skipped": self.replay_skipped,
+            "torn_dropped": self.torn_dropped,
+            "size_bytes": sh["size_bytes"],
         }
 
 

@@ -15,7 +15,8 @@ tiny hot budget (spills every couple of commits), fold-every-spill GC,
 and a wide post-arm batch that forces spill + fold + manifest in the
 same commit the WAL barrier fsyncs.
 
-Usage: python tests/_wal_crash_worker.py SITE DURABLE_DIR ACK_LOG
+Usage: python tests/_wal_crash_worker.py SITE DURABLE_DIR ACK_LOG \
+           [shared]
 """
 import json
 import os
@@ -23,12 +24,16 @@ import sys
 import threading
 
 SITE, DDIR, ACK_LOG = sys.argv[1], sys.argv[2], sys.argv[3]
+SHARED = len(sys.argv) > 4 and sys.argv[4] == "shared"
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "true"
 os.environ["GRAFT_OPLOG_HOT_OPS"] = "8"
 os.environ["GRAFT_OPLOG_GC_SEGS"] = "1"
+# tiny materialization cadence: the armed wide commit must cross the
+# matz refresh too, so the mid-matz-write site fires within one commit
+os.environ["GRAFT_MATZ_TAIL_OPS"] = "8"
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax  # noqa: E402
@@ -50,6 +55,7 @@ PRELUDE_ACKS = 4          # committed-and-durable history before arming
 
 def main() -> None:
     engine = ServingEngine(durable_dir=DDIR, wal_sync="batch",
+                           wal_shared=SHARED,
                            flight=flight_mod.FlightRecorder(),
                            submit_timeout_s=10.0)
     srv = make_server(port=0, store=engine)

@@ -67,6 +67,12 @@ def _spawn_fleet(phase, coord_port, http_port, pid, ckpt_dir):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # fast failure detection: this harness WANTS the injected death
+    # observed promptly — the default detector (10 s × 10 misses)
+    # would stall the surviving gang member ~100 s per phase, which
+    # was most of this test's wall time (tier-1 budget satellite)
+    env["GRAFT_DIST_HEARTBEAT_S"] = "1"
+    env["GRAFT_DIST_MAX_MISSING"] = "4"
     return subprocess.Popen(
         [sys.executable, _FLEET, phase, str(coord_port), str(http_port),
          str(pid), ckpt_dir],

@@ -455,6 +455,131 @@ def test_memory_bound_500k_resident_is_o_hot_window(tmp_path):
     assert log.telemetry()["cache_bytes"] <= 0.15 * untiered_bytes
 
 
+# -- chunked checkpoint base (ISSUE 11) --------------------------------------
+
+
+def test_base_chunks_window_identity_at_chunk_seams(tmp_path):
+    """With the base split into bounded chunks, every window —
+    including ones whose terminator sits exactly on a chunk seam or
+    whose body spans several chunks — stays byte- and meta-identical
+    to the untiered ``packed_since_window``."""
+    ops = mixed_ops(30)
+    t = applied_log_tree(ops)
+    applied = list(t._log)
+    p = packed_mod.pack(applied, max_depth=4)
+    log = tiered_copy(applied, tmp_path, "bc", hot_ops=8,
+                      gc_min_segs=1, base_chunk_ops=16)
+    log.set_stable_mark(len(log))
+    log.run_gc()
+    tele = log.telemetry()
+    assert tele["segments"]["base"] >= 3, tele
+    assert tele["base_ops"] == len(log) - tele["hot_ops"]
+    view = log.view(max_depth=4)
+    adds = [op.ts for op in applied if isinstance(op, Add)]
+    for since in [0] + adds + adds[-2:]:
+        for limit in (0, 1, 3, 8, 16, 17, 1000):
+            want = engine.packed_since_window(p, since, limit)
+            got = view.window(since, limit)
+            assert got[0] == want[0], (since, limit)
+            assert got[1] == want[1], (since, limit)
+    # incremental folds keep appending chunks, identically
+    log.extend(chain_ops(7, 40))
+    log.maybe_spill()
+    log.set_stable_mark(len(log))
+    log.run_gc()
+    assert log.telemetry()["segments"]["base"] >= \
+        tele["segments"]["base"]
+    full = log.view(max_depth=4)
+    p2 = full.to_packed()
+    for since in (0, adds[5], adds[-1]):
+        want = engine.packed_since_window(p2, since, 9)
+        got = log.view(max_depth=4).window(since, 9)
+        assert got[0] == want[0] and got[1] == want[1], since
+
+
+def test_mid_history_window_opens_only_covering_chunks(tmp_path):
+    """The resident-bytes bound (acceptance): a mid-history catch-up
+    window over a fully-folded log loads ONLY its covering base
+    chunks — the cache holds O(covering chunks), never the whole
+    base, and the byte-denominated LRU (GRAFT_OPLOG_CACHE_MB) counts
+    its evictions."""
+    from crdt_graph_tpu.bench import workloads
+    from crdt_graph_tpu.oplog import OpLog as _OpLog, _packed_resident
+    n = 60_000
+    arrs = workloads.chain_workload(n_replicas=8, n_ops=n)
+    p = packed_mod.PackedOps(
+        kind=arrs["kind"], ts=arrs["ts"],
+        parent_ts=arrs["parent_ts"], anchor_ts=arrs["anchor_ts"],
+        depth=arrs["depth"], paths=arrs["paths"],
+        value_ref=arrs["value_ref"], pos=arrs["pos"],
+        values=[f"v{i}" for i in range(n)], num_ops=n,
+        parent_pos=arrs["parent_pos"], anchor_pos=arrs["anchor_pos"],
+        target_pos=arrs["target_pos"], ts_rank=arrs["ts_rank"],
+        hints_vouched=True)
+    chunk = 8192
+    log = _OpLog()
+    log.extend_packed(p)
+    log.enable_tiering(str(tmp_path / "cw"), hot_ops=2048,
+                       gc_min_segs=1, base_chunk_ops=chunk)
+    log.maybe_spill()
+    log.set_stable_mark(len(log))
+    log.run_gc()
+    tele = log.telemetry()
+    assert tele["segments"]["base"] >= 6, tele
+    whole_base_resident = _packed_resident(p)  # upper-ruler: full log
+    view = log.view(1)
+    loads0 = tele["segment_loads"]
+    # one bounded mid-history window → at most the 1-2 chunks that
+    # cover it load; the cache stays O(chunk), not O(base)
+    body, meta = view.window(int(arrs["ts"][n // 2]), 256)
+    assert meta["found"] and meta["count"] >= 256
+    tele = log.telemetry()
+    assert 1 <= tele["segment_loads"] - loads0 <= 2, tele
+    per_chunk = whole_base_resident * (chunk / n)
+    assert tele["cache_bytes"] <= 2.5 * per_chunk, \
+        (tele["cache_bytes"], per_chunk, whole_base_resident)
+    assert tele["cache_bytes"] < 0.2 * whole_base_resident
+    # a sweep across the whole history stays byte-bounded by the LRU
+    # knob and counts evictions (the shared-sizing satellite)
+    small = _OpLog()
+    small.extend_packed(p)
+    small.enable_tiering(str(tmp_path / "cw2"), hot_ops=2048,
+                         gc_min_segs=1, base_chunk_ops=chunk,
+                         cache_mb=1)
+    small.maybe_spill()
+    small.set_stable_mark(len(small))
+    small.run_gc()
+    sview = small.view(1)
+    for i in range(4, n, n // 9):
+        body, meta = sview.window(int(arrs["ts"][i]), 128)
+        assert meta["found"], i
+    stele = small.telemetry()
+    assert stele["cache_evictions"] >= 1, stele
+    assert stele["cache_bytes"] <= 2 * (1 << 20), stele
+
+
+def test_fold_rewrites_only_trailing_partial_chunk(tmp_path):
+    """Write-amplification bound: an incremental fold may rewrite the
+    trailing PARTIAL chunk but never a full one — earlier full chunks
+    keep their exact files across later folds."""
+    applied = list(applied_log_tree(mixed_ops(40))._log)
+    log = tiered_copy(applied, tmp_path, "wa", hot_ops=8,
+                      gc_min_segs=1, base_chunk_ops=16)
+    log.set_stable_mark(len(log))
+    log.run_gc()
+    full_before = {cs.path for cs in log._bases
+                   if cs.length == 16}
+    assert full_before
+    log.extend(chain_ops(8, 60))
+    log.maybe_spill()
+    log.set_stable_mark(len(log))
+    log.run_gc()
+    after = {cs.path for cs in log._bases}
+    assert full_before <= after, \
+        "a fold rewrote full base chunks (unbounded write amp)"
+    assert list(log) == applied + chain_ops(8, 60)
+
+
 # -- serving integration + exposition ----------------------------------------
 
 

@@ -337,20 +337,28 @@ def test_disk_full_sheds_503_and_server_stays_up(tmp_path):
 # -- the crash-point matrix (deterministic, in-process) --------------------
 
 
+@pytest.mark.parametrize("shared", (False, True),
+                         ids=("perdoc", "shared"))
 @pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
-def test_crash_point_matrix_zero_acked_loss(tmp_path, site,
+def test_crash_point_matrix_zero_acked_loss(tmp_path, site, shared,
                                             monkeypatch):
-    """One kill site per run: acked writes survive, the recovered doc
-    serves immediately at a bumped epoch, windows stay byte-identical,
-    and the oracle's convergence check reports zero violations over
-    the recovered serving surface.  In-process kill: the CrashPoint
-    BaseException stops the scheduler exactly at the site (nothing
-    after it runs — no fsync, no publish, no ack) and everything
-    already ``write()``-en survives in the page cache, which is
-    precisely the post-SIGKILL disk state."""
+    """One kill site per run — × the per-doc AND shared WAL streams:
+    acked writes survive, the recovered doc serves immediately at a
+    bumped epoch, windows stay byte-identical, and the oracle's
+    convergence check reports zero violations over the recovered
+    serving surface.  In-process kill: the CrashPoint BaseException
+    stops the scheduler exactly at the site (nothing after it runs —
+    no fsync, no publish, no ack) and everything already
+    ``write()``-en survives in the page cache, which is precisely the
+    post-SIGKILL disk state."""
     monkeypatch.setenv("GRAFT_OPLOG_GC_SEGS", "1")
+    # a tiny materialization cadence so the armed commit also crosses
+    # the matz refresh (the mid-matz-write site must actually fire,
+    # and every OTHER site now runs with artifact writes in play too)
+    monkeypatch.setenv("GRAFT_MATZ_TAIL_OPS", "8")
     ddir = tmp_path / "dur"
-    eng = _durable_engine(ddir, submit_timeout_s=2.0)
+    eng = _durable_engine(ddir, submit_timeout_s=2.0,
+                          wal_shared=shared)
     acked = []
     ops = chain_ops(1, 80)
     for i in range(0, 15, 5):
@@ -375,11 +383,20 @@ def test_crash_point_matrix_zero_acked_loss(tmp_path, site,
     assert not eng.scheduler.is_alive(), \
         f"site {site} never fired (scheduler survived)"
     th.join(10)
-    assert crashed.get("ack") is None, \
-        f"site {site}: a write acked AFTER the crash point"
+    if site == "mid-matz-write":
+        # the artifact export runs AFTER ticket resolution (it must
+        # never sit between a client and its ack), so this site fires
+        # post-ack: the doomed commit's ack legitimately races the
+        # crash — and if it landed, it is already fsynced and must
+        # survive recovery like any other acked write
+        if crashed.get("ack") and crashed["ack"][0]:
+            acked.extend(ops[15:35])
+    else:
+        assert crashed.get("ack") is None, \
+            f"site {site}: a write acked AFTER the crash point"
     monkeypatch.delenv("GRAFT_CRASH_POINT")
     # recover from disk (the wounded engine is abandoned, un-closed)
-    eng2 = _durable_engine(ddir)
+    eng2 = _durable_engine(ddir, wal_shared=shared)
     doc2 = eng2.get("doc", create=False)
     assert doc2 is not None and doc2.epoch == 2
     vals = set(doc2.snapshot())
@@ -405,6 +422,323 @@ def test_crash_point_matrix_zero_acked_loss(tmp_path, site,
     ok, _ = _submit(eng2, "doc", chain_ops(9, 3))
     assert ok
     eng2.close()
+
+
+# -- persisted materialization (ISSUE 11) -----------------------------------
+
+
+def test_matz_corruption_taxonomy(tmp_path):
+    """The artifact's failure modes, each the SAFE way: a stale
+    artifact dup-absorbs through tail replay, a crc-flipped or
+    truncated or missing artifact falls back to the full first merge
+    with a typed MatzWarning and a counted fallback — never wrong
+    data, never an exception to the reader."""
+    import glob
+    import warnings
+
+    from crdt_graph_tpu.serve import snapshot as snapshot_mod
+
+    def fresh(dirname, n=900):
+        t = engine.init(0)
+        t.enable_log_tiering(str(tmp_path / dirname), hot_ops=64,
+                             gc_min_segs=2)
+        t.apply_packed(packed_mod.pack(chain_ops(1, n), max_depth=4))
+        return t
+
+    ref = engine.init(0)
+    ref.apply(Batch(tuple(chain_ops(1, 900))))
+    want_vals = ref.visible_values()
+    want_fp = snapshot_mod.derive("d", 0, ref).state_fingerprint()
+
+    # (a) healthy: first read comes off the artifact, zero fallbacks
+    t = fresh("ok")
+    t.checkpoint_tiered(str(tmp_path / "ok"))
+    r = engine.TpuTree.restore_tiered(str(tmp_path / "ok"))
+    assert r.visible_values() == want_vals
+    assert r.matz_stats == {"writes": 0, "loads": 1, "fallbacks": 0,
+                            "tail_replayed": 0}
+    assert snapshot_mod.derive("d", 0, r).state_fingerprint() == want_fp
+
+    # (b) stale: ops landed after the artifact — tail replay absorbs
+    t = fresh("stale", n=700)
+    assert t.write_matz()
+    t.apply(Batch(tuple(chain_ops(1, 200, start=701))))
+    t.checkpoint_tiered(str(tmp_path / "stale"), write_matz=False)
+    r = engine.TpuTree.restore_tiered(str(tmp_path / "stale"))
+    assert r.visible_values() == want_vals
+    assert r.matz_stats["loads"] == 1
+    assert r.matz_stats["tail_replayed"] == 200
+    assert snapshot_mod.derive("d", 0, r).state_fingerprint() == want_fp
+
+    # (c) crc-flip / truncation / missing: typed fallback, right data
+    for mode in ("flip", "trunc", "missing"):
+        d = f"bad-{mode}"
+        t = fresh(d)
+        t.checkpoint_tiered(str(tmp_path / d))
+        victim = glob.glob(str(tmp_path / d / "matz-*.npz"))[0]
+        blob = open(victim, "rb").read()
+        if mode == "flip":
+            flipped = bytearray(blob)
+            flipped[len(flipped) // 2] ^= 0xFF
+            open(victim, "wb").write(bytes(flipped))
+        elif mode == "trunc":
+            open(victim, "wb").write(blob[: len(blob) // 3])
+        else:
+            os.remove(victim)
+        r = engine.TpuTree.restore_tiered(str(tmp_path / d))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            vals = r.visible_values()
+        assert any(issubclass(x.category, engine.MatzWarning)
+                   for x in w), (mode, [x.category for x in w])
+        assert r.matz_stats["fallbacks"] == 1, mode
+        assert vals == want_vals, mode
+        assert snapshot_mod.derive("d", 0, r).state_fingerprint() \
+            == want_fp, mode
+
+
+def test_matz_overcovering_entry_degrades_never_bricks(tmp_path):
+    """A rollback truncate can shrink the TIERED extent below a matz
+    artifact's coverage while the entry legitimately survives (the cut
+    was above it).  The mid-flight manifest then carries
+    matz.len > length — restore must treat that as the lazy fallback
+    case (stale-or-unusable artifact, typed warning at worst), never a
+    CheckpointError that bricks the document."""
+    import warnings
+
+    d = str(tmp_path / "oc")
+    t = engine.init(0)
+    t.enable_log_tiering(d, hot_ops=32, gc_min_segs=1, durable=True,
+                         base_chunk_ops=64)
+    t._log.set_durable_hooks(t.manifest_meta, None)
+    t.apply_packed(packed_mod.pack(chain_ops(1, 150), max_depth=4))
+    assert t.write_matz()
+    assert t._log.matz_entry["len"] == 150
+    # enough new ops that the next fold MERGES the trailing partial
+    # chunk ([128,150)) with fresh segments into one chunk straddling
+    # the artifact's coverage boundary
+    t.apply_packed(packed_mod.pack(chain_ops(1, 70, start=151),
+                                   max_depth=4))
+    t._log.maybe_spill()
+    assert any(cs.start < 150 < cs.start + cs.length
+               for cs in t._log._bases), \
+        [(cs.start, cs.length) for cs in t._log._bases]
+    # rollback-shaped cut ABOVE the artifact's coverage: the entry
+    # survives, but the straddling chunk's prefix re-hots and the
+    # durable manifest's length drops below matz.len
+    t._log.truncate(160)
+    assert t._log.matz_entry is not None
+    extent = t._log.tiered_extent
+    # the brick only reproduces when the manifest length undercuts the
+    # coverage; the chunk layout guarantees it here
+    assert extent < 150, extent
+    r = engine.TpuTree.restore_tiered(d)     # must not raise
+    assert r.log_length == extent
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        vals = r.visible_values()
+    ref = engine.init(0)
+    ref.apply(Batch(tuple(chain_ops(1, extent))))
+    assert vals == ref.visible_values()
+
+
+def test_recovered_doc_first_read_from_matz_flight_and_prom(
+        tmp_path, monkeypatch):
+    """Serving-side cold-path collapse: a durable doc refreshes its
+    artifact at the GRAFT_MATZ_TAIL_OPS cadence; a restarted engine's
+    first read loads it (no full merge), commits stamp ``matz_hit``
+    into their flight records, and the crdt_matz_* families render
+    under the strict prom contract."""
+    monkeypatch.setenv("GRAFT_MATZ_TAIL_OPS", "16")
+    ddir = tmp_path / "dur"
+    eng = _durable_engine(ddir)
+    for i in range(4):
+        ok, _ = _submit(eng, "mdoc", chain_ops(1, 12, start=1 + 12 * i))
+        assert ok
+    doc = eng.get("mdoc")
+    vals = doc.snapshot()
+    assert doc.tree.matz_stats["writes"] >= 1
+    assert doc.tree._log.matz_entry is not None
+    assert eng.flush(30)
+    # abandon un-closed; recover
+    eng2 = _durable_engine(ddir)
+    doc2 = eng2.get("mdoc", create=False)
+    assert doc2 is not None and doc2.recovered
+    assert doc2.snapshot() == vals
+    assert doc2.tree.matz_stats["loads"] == 1, doc2.tree.matz_stats
+    assert doc2.tree.matz_stats["fallbacks"] == 0
+    m = doc2.metrics()["matz"]
+    assert m["loads"] == 1 and m["len"] > 0
+    # a post-recovery commit's flight record stamps the hit
+    ok, _ = _submit(eng2, "mdoc", chain_ops(1, 3, start=49))
+    assert ok
+    rec = [r for r in eng2.flight.records()
+           if r.doc_id == "mdoc" and r.outcome == "committed"][-1]
+    assert rec.matz_hit is True
+    fams = prom_mod.parse_text(eng2.render_prom())
+    for fam in ("crdt_matz_writes_total", "crdt_matz_loads_total",
+                "crdt_matz_fallbacks_total",
+                "crdt_matz_tail_replayed_total",
+                "crdt_matz_covered_ops",
+                "crdt_oplog_cache_evictions_total"):
+        assert fam in fams, fam
+    loads = [v for _, lbl, v in
+             fams["crdt_matz_loads_total"]["samples"]
+             if lbl["doc"] == "mdoc"]
+    assert loads == [1.0]
+    eng2.close()
+    eng.close()
+
+
+# -- shared WAL stream (ISSUE 11) --------------------------------------------
+
+
+def test_shared_wal_one_fsync_covers_whole_round(tmp_path):
+    """The amortization headline, deterministically: N documents'
+    writes staged under a paused scheduler resolve in ONE round with
+    ONE shared fsync covering all of them (per-doc mode pays N), at
+    the same fsync-before-ack point."""
+    n_docs = 6
+    eng = _durable_engine(tmp_path / "dur", wal_shared=True,
+                          oplog_hot_ops=4096)
+    assert eng.shared_wal is not None
+    eng.scheduler.pause()
+    results = []
+
+    def writer(k):
+        ops = [Add(ts(2 + k, 1), (0,), f"w{k}")]
+        results.append(_submit(eng, f"sdoc{k}", ops))
+
+    threads = [threading.Thread(target=writer, args=(k,), daemon=True)
+               for k in range(n_docs)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        docs = [eng.get(f"sdoc{k}", create=False)
+                for k in range(n_docs)]
+        if all(d is not None and len(d.queue) == 1 for d in docs):
+            break
+        time.sleep(0.005)
+    fsyncs0 = eng.shared_wal.telemetry()["fsyncs"]
+    eng.scheduler.resume()
+    for t in threads:
+        t.join(30)
+    assert len(results) == n_docs and all(ok for ok, _ in results)
+    st = eng.shared_wal.telemetry()
+    assert st["fsyncs"] - fsyncs0 == 1, st
+    assert st["appends"] >= n_docs
+    # the covered-docs histogram saw the whole round at once
+    cov = st["covered_docs"]
+    assert cov is not None and cov["count"] >= 1
+    assert eng.counters.snapshot().get("wal_shared_covered_docs", 0) \
+        >= n_docs
+    # every doc's commit billed the one fsync into its stages
+    for k in range(n_docs):
+        rec = [r for r in eng.flight.records()
+               if r.doc_id == f"sdoc{k}" and r.outcome == "committed"]
+        assert rec and "wal_fsync" in rec[-1].stages_ms
+    fams = prom_mod.parse_text(eng.render_prom())
+    for fam in ("crdt_wal_shared_fsyncs_total",
+                "crdt_wal_shared_appends_total",
+                "crdt_wal_shared_covered_docs",
+                "crdt_wal_shared_fsync_ms",
+                "crdt_wal_shared_size_bytes"):
+        assert fam in fams, fam
+    eng.close()
+
+
+def test_shared_wal_failed_sync_after_repair_reopen_drops_tail(
+        tmp_path):
+    """A repair mid-round closes the handle; the reopen must NOT
+    promote the still-unsynced earlier record to durable — when the
+    round's fsync then fails, the whole unsynced tail (whose commits
+    are all being shed) must drop, or recovery would resurrect a
+    write its client was told failed."""
+    sh = wal_mod.SharedWal(str(tmp_path / "s.log"))
+    sh.append("A", packed_mod.pack(chain_ops(1, 3)), 3)   # unsynced
+    # doc B's append dies mid-write: repair truncates the partial
+    # bytes and closes the handle
+    sh._repair_locked(sh._size)
+    # doc C's append reopens the file (A's record still unsynced)
+    sh.append("C", packed_mod.pack(chain_ops(2, 3)), 3)
+    real = os.fsync
+
+    def eio(fd):
+        raise OSError(5, "Input/output error")
+
+    os.fsync = eio
+    try:
+        with pytest.raises(OSError):
+            sh.sync(covered_docs=2)
+    finally:
+        os.fsync = real
+    sh.close()
+    # every record in the failed round was shed: none may survive
+    records, torn, _ = wal_mod.scan_shared(str(tmp_path / "s.log"))
+    assert [(r[1], r[2]) for r in records] == [], records
+
+
+def test_wal_mode_flip_across_restart_refuses_loudly(tmp_path):
+    """Restarting a durable dir under the OTHER WAL format must fail
+    with a typed WalError, not silently drop the previous format's
+    fsync-acked tail (only the writing format can replay it)."""
+    ddir = tmp_path / "dur"
+    # per-doc incarnation leaves an un-truncated wal.log tail
+    eng = _durable_engine(ddir, oplog_hot_ops=4096)
+    ok, _ = _submit(eng, "flip", chain_ops(1, 6))
+    assert ok
+    assert eng.flush(20)
+    with pytest.raises(wal_mod.WalError, match="per-doc WAL"):
+        _durable_engine(ddir, wal_shared=True)
+    # the honest restart (same mode) still recovers fine
+    eng2 = _durable_engine(ddir)
+    assert eng2.get("flip", create=False).snapshot() \
+        == eng.get("flip").snapshot()
+    eng2.close()
+    eng.close()
+    # and the reverse: a shared incarnation's stream blocks a per-doc
+    # restart
+    ddir2 = tmp_path / "dur2"
+    eng3 = _durable_engine(ddir2, wal_shared=True, oplog_hot_ops=4096)
+    ok, _ = _submit(eng3, "flip2", chain_ops(1, 6))
+    assert ok
+    assert eng3.flush(20)
+    with pytest.raises(wal_mod.WalError, match="shared WAL stream"):
+        _durable_engine(ddir2)
+    eng4 = _durable_engine(ddir2, wal_shared=True)
+    assert eng4.get("flip2", create=False).snapshot() \
+        == eng3.get("flip2").snapshot()
+    eng4.close()
+    eng3.close()
+
+
+def test_shared_wal_disk_full_sheds_all_covered_commits(tmp_path):
+    """A failed SHARED fsync sheds and rolls back EVERY commit it
+    covered (their records share the dropped unsynced tail) — and the
+    disk recovering restores the write path for all of them."""
+    eng = _durable_engine(tmp_path / "dur", wal_shared=True)
+    for k in range(2):
+        ok, _ = _submit(eng, f"fdoc{k}", chain_ops(1, 4))
+        assert ok
+    real_sync = eng.shared_wal.sync
+
+    def enospc(covered_docs=1):
+        raise OSError(28, "No space left on device")
+
+    eng.shared_wal.sync = enospc
+    try:
+        with pytest.raises(WalUnavailable):
+            _submit(eng, "fdoc0", chain_ops(1, 4, start=5))
+    finally:
+        eng.shared_wal.sync = real_sync
+    doc = eng.get("fdoc0")
+    assert doc.tree.log_length == 4      # rolled back
+    assert eng.scheduler.is_alive()
+    ok, _ = _submit(eng, "fdoc0", chain_ops(1, 4, start=5))
+    assert ok
+    assert doc.tree.log_length == 8
+    eng.close()
 
 
 # -- satellites ------------------------------------------------------------
@@ -537,18 +871,23 @@ def _proc_env():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
-def test_wal_crash_point_process_matrix(tmp_path, site):
+@pytest.mark.parametrize(
+    "site,shared",
+    [(s, False) for s in wal_mod.CRASH_SITES]
+    + [("ack-pre-fsync", True), ("post-fsync-pre-publish", True),
+       ("mid-matz-write", True)])
+def test_wal_crash_point_process_matrix(tmp_path, site, shared):
     """The real thing: a server process dies by os._exit(137) at the
     armed site mid-HTTP-traffic; a fresh engine recovers the durable
-    dir with zero acked-write loss."""
+    dir with zero acked-write loss — per-doc WAL at every site, plus
+    the shared stream at its own durability boundaries."""
     ddir = str(tmp_path / "dur")
     ack_log = str(tmp_path / "acked.txt")
     proc = subprocess.run(
         [sys.executable,
          os.path.join(os.path.dirname(__file__),
                       "_wal_crash_worker.py"),
-         site, ddir, ack_log],
+         site, ddir, ack_log] + (["shared"] if shared else []),
         cwd=os.path.join(os.path.dirname(__file__), ".."),
         env=_proc_env(), capture_output=True, text=True, timeout=300)
     assert proc.returncode == 137, \
@@ -556,6 +895,7 @@ def test_wal_crash_point_process_matrix(tmp_path, site):
     acked = [ln for ln in open(ack_log).read().splitlines() if ln]
     assert acked, "worker crashed before anything was acked"
     eng = ServingEngine(durable_dir=ddir, wal_sync="batch",
+                        wal_shared=shared,
                         flight=flight_mod.FlightRecorder())
     doc = eng.get("crash", create=False)
     assert doc is not None
@@ -678,6 +1018,47 @@ def test_wal_sigkill_fleet_soak(tmp_path):
                 p.wait(20)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_bench_coldpath_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_COLDPATH_r01_cpu.json shape,
+    reduced): restore-to-first-read off the materialization artifact
+    beats the full-first-merge path ≥5× with bit-identical
+    fingerprints, the chunked base bounds a mid-history window's
+    resident bytes, and the shared WAL collapses fsyncs/round on the
+    many-doc fleet shape with zero oracle violations."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bench_coldpath_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_coldpath_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_COLDPATH_test.json"),
+                  n_ops=400_000, restore_rounds=1,
+                  fleet_docs=24, fleet_sessions=24, fleet_writes=3,
+                  fleet_rounds=1)
+    assert out["fingerprints_equal"]
+    assert out["restore"]["speedup_to_first_read"] >= 5.0, \
+        out["restore"]
+    best = out["restore"]["best"]
+    assert best["matz"]["matz_stats"]["loads"] == 1
+    assert best["matz"]["matz_stats"]["fallbacks"] == 0
+    # the chunked base keeps a cold window's resident footprint to its
+    # covering chunks (400k ops → ≥3 chunks; monolith holds all)
+    cat = out["catchup"]
+    assert cat["chunked"]["base_chunks"] >= 3
+    assert cat["monolith"]["base_chunks"] == 1
+    assert cat["resident_ratio"] <= 0.6, cat
+    # shared stream amortizes fsyncs on a multi-doc round (the full
+    # 64-doc committed artifact holds the ≥8x headline; the reduced
+    # tier-shape gate is looser against 1-core scheduling noise)
+    fl = out["fleet"]
+    assert fl["best"]["shared"]["violations"] == 0
+    assert fl["best"]["perdoc"]["violations"] == 0
+    assert fl["fsyncs_per_round_reduction"] >= 2.0, fl
+    assert fl["shared_vs_perdoc_throughput"] >= 0.8, fl
 
 
 @pytest.mark.slow
