@@ -395,6 +395,33 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     n = len(read_ms)
     na = len(ack_ms)
     ost = oracle.stats()
+
+    # ack-latency breakdown off the flight stream (ISSUE 12): where a
+    # committed write's latency went — merge compute vs pipeline queue
+    # wait vs the fsync itself.  Pipelined engines hide the queue wait
+    # under the NEXT round's compute; serialized engines pay all three
+    # in series, which is exactly the contrast the pipeline headline
+    # bench reports.
+    stage_rows = [r.stages_ms for r in engine.flight.records()
+                  if r.outcome in ("committed", "partial")]
+
+    def _stage_stats(keys):
+        vals = sorted(sum(s.get(k, 0.0) for k in keys)
+                      for s in stage_rows)
+        if not vals:
+            return None
+        return {"mean": round(sum(vals) / len(vals), 3),
+                "p50": round(vals[len(vals) // 2], 3),
+                "p99": round(vals[min(len(vals) - 1,
+                                      (99 * len(vals)) // 100)], 3)}
+
+    ack_breakdown = {
+        "compute": _stage_stats(("fuse", "merge", "publish",
+                                 "batch_prepare", "batched_launch")),
+        "fsync_queue": _stage_stats(("wal_fsync_queued",)),
+        "fsync_wait": _stage_stats(("wal_fsync",)),
+        "wal_append": _stage_stats(("wal_append",)),
+    }
     out = {
         "harness": "loadgen",
         "sessions": cfg.n_sessions,
@@ -432,6 +459,15 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         "wal_shared": (engine.shared_wal.telemetry()
                        if getattr(engine, "shared_wal", None)
                        is not None else None),
+        # pipelined commit path + maintenance lane (ISSUE 12):
+        # where ack latency went, and what left the scheduler thread
+        "ack_breakdown_ms": ack_breakdown,
+        "pipeline": (engine.sync_worker.stats()
+                     if getattr(engine, "sync_worker", None)
+                     is not None else None),
+        "maint": (engine.maintenance.stats()
+                  if getattr(engine, "maintenance", None)
+                  is not None else None),
         "shed_429": sum(s.shed_429 for s in sessions),
         "giant_ops": cfg.giant_ops,
         "giant_commit_s": round(giant_s, 3) if giant_s else None,

@@ -1020,12 +1020,19 @@ class TpuTree:
         return m.values[int(m.value_ref[s])]
 
     def _ensure_packed(self) -> PackedOps:
-        if self._packed is None:
+        # read-once into a local: the background maintenance worker's
+        # spill drops this cache (_on_log_spill) concurrently with the
+        # scheduler thread calling here — a re-read after the null
+        # would return None mid-merge.  A stale local is merely a
+        # memory-footprint miss, never wrong data (the packing is
+        # immutable).
+        p = self._packed
+        if p is None:
             # columnar segments union via concat — after a host edit on
             # a bootstrap-restored doc this is O(delta), not a per-op
             # re-pack of the whole history
-            self._packed = self._log.to_packed(self._max_depth)
-        return self._packed
+            p = self._packed = self._log.to_packed(self._max_depth)
+        return p
 
     def packed_state(self) -> PackedOps:
         """The whole applied log as one packed column set (cached between
@@ -1452,30 +1459,18 @@ class TpuTree:
             return self._ensure_mirror()
         return None
 
-    def _write_matz_file(self, target: str,
-                         fsync: bool = False) -> Optional[dict]:
-        """Write the materialization artifact (mirror slot arrays +
-        values + visible sequence) into ``target`` and return its
-        manifest entry ``{"file", "len"}``, or None when no mirror is
-        cheaply derivable.  tmp+rename so a manifest-referenced
-        artifact is never observed half-written."""
+    def _save_matz_npz(self, target: str, name: str, arrs: dict,
+                       values: list, meta: dict, fsync: bool) -> None:
+        """Serialize one materialization artifact (tmp + rename so a
+        manifest-referenced artifact is never observed
+        half-written)."""
         import json
-        m = self._matz_mirror_cheap()
-        if m is None:
-            return None
-        length = len(self._log)
-        name = self._log.next_matz_name() \
-            if self._log.tiering_enabled else "matz-g1.npz"
         os.makedirs(target, exist_ok=True)
         path = os.path.join(target, name)
         tmp = path + ".tmp"
-        arrs = m.export_arrays()
-        meta = {"kind": "matz", "matz_len": length, "n": m.n,
-                "nvis": m.nvis, "max_depth": self._max_depth,
-                "values_len": len(m.values)}
         with open(tmp, "wb") as f:
             np.savez(f, values=np.frombuffer(
-                json.dumps(m.values).encode(), np.uint8),
+                json.dumps(values).encode(), np.uint8),
                 meta=np.frombuffer(json.dumps(meta).encode(),
                                    np.uint8),
                 **arrs)
@@ -1484,7 +1479,80 @@ class TpuTree:
                 os.fsync(f.fileno())
         os.replace(tmp, path)
         self.matz_stats["writes"] += 1
+
+    def _write_matz_file(self, target: str,
+                         fsync: bool = False) -> Optional[dict]:
+        """Write the materialization artifact (mirror slot arrays +
+        values + visible sequence) into ``target`` and return its
+        manifest entry ``{"file", "len"}``, or None when no mirror is
+        cheaply derivable."""
+        m = self._matz_mirror_cheap()
+        if m is None:
+            return None
+        length = len(self._log)
+        name = self._log.next_matz_name() \
+            if self._log.tiering_enabled else "matz-g1.npz"
+        meta = {"kind": "matz", "matz_len": length, "n": m.n,
+                "nvis": m.nvis, "max_depth": self._max_depth,
+                "values_len": len(m.values)}
+        self._save_matz_npz(target, name, m.export_arrays(),
+                            m.values, meta, fsync)
         return {"file": name, "len": length}
+
+    def matz_snapshot(self) -> Optional[dict]:
+        """The scheduler-thread half of the BACKGROUND materialization
+        export (serve/workers.py): spill the whole hot tail first (the
+        artifact's coverage must stay ≤ the tiered extent — the usual
+        write_matz rule), then snapshot the mirror's slot arrays
+        COPY-ON-EXPORT, so the maintenance worker can serialize the
+        O(doc-state) artifact while this thread keeps applying ops to
+        the live mirror.  The copies are flat memcpys + one pointer
+        copy of the value table (values are immutable JSON leaves) —
+        milliseconds where the serialize is seconds.  None when no
+        mirror is cheaply derivable (never introduces the cost it
+        removes)."""
+        log = self._log
+        if not matz_enabled() or not log.tiering_enabled:
+            return None
+        m = self._matz_mirror_cheap()
+        if m is None:
+            return None
+        log.spill_all()
+        arrs = m.export_arrays(copy=True)
+        return {"arrs": arrs, "values": list(m.values), "n": m.n,
+                "nvis": m.nvis, "len": len(log),
+                "values_len": len(m.values)}
+
+    def export_matz(self, snap: dict) -> bool:
+        """The worker-thread half: serialize a :meth:`matz_snapshot`
+        to its artifact file and publish it atomically in the
+        manifest.  If the log was truncated below the snapshot's
+        coverage in the meantime (a shed rollback), the artifact is
+        DISCARDED — it must never resurrect rolled-back ops."""
+        from .wal import maybe_crash
+        log = self._log
+        cfg = log._cfg
+        if cfg is None or snap is None:
+            return False
+        name = log.next_matz_name()
+        meta = {"kind": "matz", "matz_len": int(snap["len"]),
+                "n": snap["n"], "nvis": snap["nvis"],
+                "max_depth": self._max_depth,
+                "values_len": snap["values_len"]}
+        self._save_matz_npz(cfg.dir, name, snap["arrs"],
+                            snap["values"], meta, fsync=cfg.durable)
+        # chaos site: artifact on disk, manifest not yet referencing
+        # it — recovery from the old manifest ignores the stray file
+        maybe_crash("mid-matz-write")
+        try:
+            log.note_matz(name, int(snap["len"]))
+        except ValueError:
+            try:
+                os.remove(os.path.join(cfg.dir, name))
+            except OSError:
+                pass
+            return False
+        return True
 
     def write_matz(self) -> bool:
         """Serving-path materialization snapshot: spill the whole hot

@@ -139,23 +139,33 @@ class HostTree:
 
     # -- persisted materialization (engine.write_matz round trip) ---------
 
-    def export_arrays(self) -> dict:
+    def export_arrays(self, copy: bool = False) -> dict:
         """The mirror's slot arrays for the materialization artifact
         (engine.TpuTree.write_matz).  ``paths`` is OMITTED — it
         rebuilds from (parent, ts, depth) in :meth:`from_arrays`, and
         at scale it is by far the widest plane (n × max_depth × 8 B).
         ``vis_refs`` is the visible sequence's value refs in document
         order: the restored first read becomes one list indexing pass
-        instead of an O(n) linked-list traversal."""
+        instead of an O(n) linked-list traversal.
+
+        ``copy=True`` returns snapshot COPIES instead of live views —
+        the background matz export (engine.TpuTree.matz_snapshot)
+        captures the mirror copy-on-export on the scheduler thread so
+        the maintenance worker can serialize while this mirror keeps
+        applying ops; a view handed across that thread boundary would
+        tear."""
         n = self.n
         vis_refs = np.fromiter(
             (self.value_ref[s] for s in self.iter_visible()),
             dtype=np.int32, count=self.nvis)
-        return {"ts": self.ts[:n], "parent": self.parent[:n],
-                "depth": self.depth[:n],
-                "value_ref": self.value_ref[:n], "tomb": self.tomb[:n],
-                "first": self.first[:n], "nxt": self.nxt[:n],
-                "prv": self.prv[:n], "vis_refs": vis_refs}
+        out = {"ts": self.ts[:n], "parent": self.parent[:n],
+               "depth": self.depth[:n],
+               "value_ref": self.value_ref[:n], "tomb": self.tomb[:n],
+               "first": self.first[:n], "nxt": self.nxt[:n],
+               "prv": self.prv[:n], "vis_refs": vis_refs}
+        if copy:
+            out = {k: np.array(v, copy=True) for k, v in out.items()}
+        return out
 
     @classmethod
     def from_arrays(cls, arrs: dict, values: List[Any],

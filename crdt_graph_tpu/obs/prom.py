@@ -369,6 +369,65 @@ def render_engine(engine) -> str:
                 w.histogram(hname, htext, h["bounds"], h["counts"],
                             h["count"], h["sum"])
 
+    # -- pipelined commit path (serve/workers.py; ISSUE 12) ---------------
+    sync_worker = getattr(engine, "sync_worker", None)
+    w.gauge("crdt_sched_pipeline_enabled",
+            "1 when the two-stage commit pipeline is armed "
+            "(GRAFT_PIPELINE, durable batch mode)",
+            1.0 if sync_worker is not None else 0.0)
+    if sync_worker is not None:
+        ps = sync_worker.stats()
+        w.counter("crdt_sched_pipeline_rounds_total",
+                  "Rounds whose group fsync rode the WAL-sync worker",
+                  ps["jobs_done"])
+        w.counter("crdt_sched_pipeline_commits_synced_total",
+                  "Commits resolved by the WAL-sync worker",
+                  ps["commits_synced"])
+        w.counter("crdt_sched_pipeline_commits_shed_total",
+                  "Commits shed by a failed pipelined fsync",
+                  ps["commits_shed"])
+        w.gauge("crdt_sched_pipeline_inflight",
+                "Fsync jobs queued or executing on the sync worker",
+                ps["inflight"])
+    maint = getattr(engine, "maintenance", None)
+    if maint is not None:
+        ms = maint.stats()
+        w.gauge("crdt_maint_queue_depth",
+                "Maintenance tasks queued or executing",
+                ms["queue_depth"])
+        w.family("crdt_maint_tasks_total", "counter",
+                 "Background maintenance tasks completed, by kind")
+        for kind in sorted(ms["tasks_done"]):
+            w.sample("crdt_maint_tasks_total", "crdt_maint_tasks_total",
+                     ms["tasks_done"][kind], {"kind": kind})
+        w.counter("crdt_maint_task_errors_total",
+                  "Maintenance tasks that failed (counted, non-fatal)",
+                  ms["task_errors"])
+        w.counter("crdt_maint_queue_full_total",
+                  "Maintenance enqueues dropped on a full queue",
+                  ms["queue_full_drops"])
+        w.counter("crdt_maint_inline_spill_fallbacks_total",
+                  "Hard-cap spills run inline on the scheduler "
+                  "because the worker lagged",
+                  ms["inline_spill_fallbacks"])
+        w.counter("crdt_maint_policy_age_spills_total",
+                  "Spills triggered by the hot-tail age policy",
+                  ms["policy_age_spills"])
+        w.counter("crdt_maint_policy_resident_spills_total",
+                  "Spills triggered by the engine-wide resident-bytes "
+                  "policy", ms["policy_resident_spills"])
+        for hname, hkey, htext in (
+                ("crdt_maint_task_ms", "task_ms",
+                 "Maintenance task execution latency"),
+                ("crdt_maint_matz_export_ms", "matz_export_ms",
+                 "Background matz artifact serialize+publish "
+                 "latency")):
+            h = ms[hkey]
+            if h and h.get("count"):
+                w.family(hname, "histogram", htext)
+                w.histogram(hname, htext, h["bounds"], h["counts"],
+                            h["count"], h["sum"])
+
     # -- engine-wide scheduler counters ----------------------------------
     for cname, val in sorted(engine.counters.snapshot().items()):
         safe = re.sub(r"[^a-zA-Z0-9_]", "_", cname)

@@ -112,7 +112,7 @@ class CommitTrace:
                  "parse_ms", "queue_depth_admission", "stages_ms",
                  "chunk_count", "applied_ops", "dup_ops", "outcome",
                  "staleness_s", "total_ms", "error", "packed",
-                 "wal_deferred")
+                 "wal_deferred", "audit_sampled", "audit_result")
 
     def __init__(self, doc_id: str, tickets) -> None:
         self.doc_id = doc_id
@@ -143,6 +143,12 @@ class CommitTrace:
         # (serve/scheduler.py WAL batch mode): publish, ticket
         # resolution, and the flight record all happen at the barrier
         self.wal_deferred = False
+        # pipelined commits presample the chain audit on the
+        # SCHEDULER thread (jaxpr tracing must never run concurrently
+        # with kernel launches); the WAL-sync worker's record then
+        # uses the stored result instead of sampling inline
+        self.audit_sampled = False
+        self.audit_result: Optional[Dict] = None
 
     @contextlib.contextmanager
     def stage(self, name: str, span_name: Optional[str] = None):

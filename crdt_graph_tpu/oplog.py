@@ -845,6 +845,21 @@ class OpLog:
         self._cache_shared = False
         self._stable: Optional[int] = None
         self._on_spill: Optional[Callable[[], None]] = None
+        # deferred spill policy (serve/workers.py MaintenanceWorker):
+        # when set, a due spill is HANDED to the worker instead of
+        # sealing segments on the calling (scheduler) thread; past the
+        # hard cap the spill runs inline anyway so resident memory
+        # stays bounded even when the worker lags (inline_cb counts
+        # those fallbacks)
+        self._defer_cb: Optional[Callable[[], None]] = None
+        self._inline_cb: Optional[Callable[[], None]] = None
+        self._hard_cap_ops = 0
+        self._hard_cap_bytes = 0
+        # age-based spill policy (GRAFT_OPLOG_HOT_AGE_S): monotonic
+        # time the oldest unspilled hot op has been resident —
+        # approximate (reset on spill: the spilled prefix IS the
+        # oldest), enough for a many-doc idle-tail sweep
+        self._hot_since: Optional[float] = None
         # durable mode (docs/DURABILITY.md): meta_cb supplies the
         # manifest's clock/cursor meta at write time; on_advance is
         # told the new tiered extent after every manifest write so
@@ -996,6 +1011,8 @@ class OpLog:
                 self._segs.append([op])
             if isinstance(op, Add):
                 self._last_add = self._len
+            if self._hot_len == 0:
+                self._hot_since = time.monotonic()
             self._len += 1
             self._hot_len += 1
 
@@ -1012,6 +1029,8 @@ class OpLog:
                 if isinstance(ops[j], Add):
                     self._last_add = self._len + j
                     break
+            if self._hot_len == 0:
+                self._hot_since = time.monotonic()
             self._len += len(ops)
             self._hot_len += len(ops)
 
@@ -1028,6 +1047,8 @@ class OpLog:
             adds = np.nonzero(p.kind[start:stop] == KIND_ADD)[0]
             if len(adds):
                 self._last_add = self._len + int(adds[-1])
+            if self._hot_len == 0:
+                self._hot_since = time.monotonic()
             self._len += stop - start
             self._hot_len += stop - start
 
@@ -1136,35 +1157,112 @@ class OpLog:
 
     # -- spill / compaction / GC ------------------------------------------
 
+    def _spill_excess_locked(self) -> Tuple[int, bool]:
+        """``(excess_ops, due)`` under the hot op/byte budgets."""
+        cfg = self._cfg
+        excess = self._hot_len - cfg.hot_ops
+        due = excess >= max(1, cfg.hot_ops // 4)
+        if cfg.hot_bytes and self._hot_len > 1:
+            hb = self._hot_bytes_locked()
+            # the byte path's hysteresis is BYTE-denominated: with
+            # large per-op values, waiting for hot_ops//4 excess
+            # OPS would overshoot the byte budget many times over
+            if hb - cfg.hot_bytes > cfg.hot_bytes // 4:
+                per = hb / self._hot_len
+                excess = max(excess,
+                             int((hb - cfg.hot_bytes) / per))
+                due = excess > 0
+        return excess, due
+
     def maybe_spill(self) -> bool:
         """Spill the hot tail past its budget (and, when due, advance
         the checkpoint base + GC watermark-cleared segments).  Called by
         the engine at commit boundaries only — never mid-batch or
         mid-chunked-apply, so a rollback's target range is always still
         hot.  Returns True when ops moved to disk (the owner should
-        drop any full-packing cache)."""
+        drop any full-packing cache).
+
+        With a deferred spill policy armed (:meth:`set_spill_policy`),
+        a due spill is handed to the maintenance worker instead — the
+        O(hot tail) seal (and the fold/GC behind it) leaves the
+        calling thread entirely — UNLESS the hot tail has breached the
+        hard cap (the worker is lagging), in which case the spill runs
+        inline so resident memory stays bounded regardless."""
         cfg = self._cfg
         if cfg is None:
             return False
         spilled = False
+        deferred = inline_fallback = False
         with self._mu:
-            excess = self._hot_len - cfg.hot_ops
-            due = excess >= max(1, cfg.hot_ops // 4)
-            if cfg.hot_bytes and self._hot_len > 1:
-                hb = self._hot_bytes_locked()
-                # the byte path's hysteresis is BYTE-denominated: with
-                # large per-op values, waiting for hot_ops//4 excess
-                # OPS would overshoot the byte budget many times over
-                if hb - cfg.hot_bytes > cfg.hot_bytes // 4:
-                    per = hb / self._hot_len
-                    excess = max(excess,
-                                 int((hb - cfg.hot_bytes) / per))
-                    due = excess > 0
+            excess, due = self._spill_excess_locked()
             if due and excess > 0:
-                self._spill_locked(min(excess, self._hot_len))
-                spilled = True
+                below_cap = (self._hard_cap_ops <= 0
+                             or self._hot_len < self._hard_cap_ops) \
+                    and (self._hard_cap_bytes <= 0
+                         or self._hot_bytes_locked()
+                         < self._hard_cap_bytes)
+                if self._defer_cb is not None and below_cap:
+                    deferred = True
+                else:
+                    inline_fallback = self._defer_cb is not None
+                    self._spill_locked(min(excess, self._hot_len))
+                    spilled = True
             if cfg.auto_stable:
                 self._stable = self._len
+            if self._defer_cb is None or inline_fallback:
+                # deferred mode leaves fold/GC to the worker (it runs
+                # them behind each spill task) — EXCEPT on the
+                # hard-cap inline fallback: the worker is lagging or
+                # wedged, so cleanup must not wait on it either
+                self._gc_locked()
+                self._sweep_tombs_locked()
+        self._fire_advance()
+        if inline_fallback and self._inline_cb is not None:
+            try:
+                self._inline_cb()
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                pass
+        if deferred and self._defer_cb is not None:
+            try:
+                self._defer_cb()
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                pass
+        if spilled and self._on_spill is not None:
+            try:
+                self._on_spill()
+            except Exception:   # noqa: BLE001 — owner callback boundary
+                pass
+        return spilled
+
+    def spill_to(self, extent: int,
+                 keep_hot: Optional[int] = None) -> bool:
+        """Background-worker spill (serve/workers.py): seal hot ops
+        into cold segments WITHOUT advancing the tiered extent past
+        ``extent`` — rows at or past it may still be rolled back by a
+        failed group-commit fsync, so the worker only ever spills rows
+        the scheduler has proven durable (``ServedDoc`` safe extent).
+        ``keep_hot`` overrides the budget floor (0 = drain the whole
+        eligible tail, the age/resident-bytes policy sweeps).  Runs
+        fold/GC and tomb sweeping afterwards, exactly like the inline
+        commit-boundary path did.  Returns True when ops moved to
+        disk."""
+        spilled = False
+        with self._mu:
+            cfg = self._cfg
+            if cfg is None:
+                return False
+            keep = cfg.hot_ops if keep_hot is None else max(0, keep_hot)
+            k = min(self._hot_len - keep,
+                    max(0, int(extent) - self._tiered_len),
+                    self._hot_len)
+            if k > 0:
+                self._spill_locked(k)
+                spilled = True
+            # chaos site: the background worker's spill landed (new
+            # manifest referencing the sealed segments) but the fold/GC
+            # pass has not run — recovery reopens the manifest and
+            # replays the WAL tail past it (docs/DURABILITY.md)
+            _maybe_crash("mid-bg-fold")
             self._gc_locked()
             self._sweep_tombs_locked()
         self._fire_advance()
@@ -1177,6 +1275,55 @@ class OpLog:
 
     def set_on_spill(self, cb: Optional[Callable[[], None]]) -> None:
         self._on_spill = cb
+
+    def set_spill_policy(self, defer_cb: Optional[Callable[[], None]],
+                         inline_cb: Optional[Callable[[], None]] = None,
+                         hard_cap_ops: int = 0,
+                         hard_cap_bytes: int = 0) -> None:
+        """Arm (or disarm, ``defer_cb=None``) the deferred spill
+        policy: due spills are handed to ``defer_cb`` (the maintenance
+        worker's enqueue) instead of sealing inline, with an inline
+        fallback past ``hard_cap_ops`` resident hot ops — or past
+        ``hard_cap_bytes`` resident hot bytes, the twin cap for
+        byte-budgeted tails (few huge ops would never trip the op
+        count) — (``inline_cb`` counts those; memory stays bounded
+        even when the worker lags)."""
+        with self._mu:
+            self._defer_cb = defer_cb
+            self._inline_cb = inline_cb
+            self._hard_cap_ops = max(0, int(hard_cap_ops))
+            self._hard_cap_bytes = max(0, int(hard_cap_bytes))
+
+    def spill_due(self) -> bool:
+        """Whether the hot tail is past its spill budget right now —
+        the WAL-sync worker re-checks after each fsync advances the
+        spill-safe extent (a spill task capped at the old extent may
+        have left the tail over budget)."""
+        with self._mu:
+            if self._cfg is None:
+                return False
+            excess, due = self._spill_excess_locked()
+            return due and excess > 0
+
+    @property
+    def hot_len(self) -> int:
+        with self._mu:
+            return self._hot_len
+
+    def hot_bytes(self) -> int:
+        """Resident bytes of the hot tail alone (the engine-wide
+        resident-budget policy ranks documents by this)."""
+        with self._mu:
+            return self._hot_bytes_locked()
+
+    def hot_age_s(self) -> float:
+        """Seconds the (approximate) oldest hot op has been resident —
+        0.0 for an empty tail.  The age-based spill policy
+        (``GRAFT_OPLOG_HOT_AGE_S``) sweeps tails past this."""
+        with self._mu:
+            if not self._hot_len or self._hot_since is None:
+                return 0.0
+            return time.monotonic() - self._hot_since
 
     def set_durable_hooks(self, meta_cb: Optional[Callable[[], dict]],
                           on_advance: Optional[Callable[[int], None]]
@@ -1401,6 +1548,8 @@ class OpLog:
             # replay the untruncated WAL over it (the stray files are
             # unreferenced and harmlessly overwritten later)
             _maybe_crash("mid-spill")
+        # the age clock restarts: the spilled prefix was the oldest
+        self._hot_since = time.monotonic() if self._hot_len else None
         self._durable_manifest_locked()
 
     def run_gc(self) -> None:

@@ -73,8 +73,9 @@ DEFAULT_CHUNK_OPS = 1 << 17
 # disables tiering entirely.
 DEFAULT_OPLOG_HOT_OPS = 32768
 
+from ..utils.hostenv import env_float as _env_float  # noqa: E402
 from ..utils.hostenv import env_int as _env_int  # noqa: E402 — the
-# canonical int-env parser (shared with obs/flight.py's sizing knobs)
+# canonical int/float env parsers (shared with obs/flight.py's knobs)
 
 
 class ServedDoc:
@@ -104,6 +105,24 @@ class ServedDoc:
         # pre-commit state for the WAL shed rollback (scheduler
         # thread only; one commit per doc per round)
         self._commit_saved: Optional[tuple] = None
+        # pipelined commit path (serve/workers.py; ISSUE 12):
+        # _safe_extent = the log extent no failed group fsync can roll
+        # back (fsync-durable, or fully-resolved for wal-off docs) —
+        # the ONLY rows the background maintenance worker may spill;
+        # _round_records buffers the current round's encoded WAL
+        # records (scheduler thread only); _matz_due marks a
+        # cadence-due artifact refresh for the scheduler's pickup
+        self._safe_extent = 0
+        self._matz_due = False
+        self._round_records: list = []
+        # entries of THIS doc in flight on the WAL-sync worker
+        # (guarded by the worker's condition) — the per-doc pipeline
+        # barrier: a doc's next record appends only after its
+        # previous fsync resolved; other docs flow freely
+        self._sync_inflight = 0
+        # seq assigned at snapshot DERIVE time (prepare_publish): the
+        # published seq trails it by the in-flight pipeline window
+        self._prepared_seq = 0
         if engine.durable_dir is not None:
             self._init_durable(engine, max_depth)
         else:
@@ -143,6 +162,25 @@ class ServedDoc:
         self._seq = 0
         self._snap = snapshot_mod.derive(doc_id, 0, self.tree)
         self._prev_snap: Optional[snapshot_mod.DocSnapshot] = None
+        # everything restored/replayed so far is durable (or, for
+        # non-durable docs, committed) — background spills may cover it
+        self._safe_extent = self.tree.log_length
+        if engine.maintenance is not None \
+                and self.tree._log.tiering_enabled:
+            # deferred spill policy: due spills leave the scheduler
+            # thread for the maintenance worker, with the hard-cap
+            # inline fallback keeping memory bounded when it lags
+            maint = engine.maintenance
+            hot_bytes = _env_int("GRAFT_OPLOG_HOT_BYTES", 0)
+            self.tree._log.set_spill_policy(
+                lambda: maint.enqueue("spill", self),
+                inline_cb=maint.note_inline_spill,
+                hard_cap_ops=engine.oplog_hot_hard_ops,
+                # byte-budgeted tails get a byte-denominated cap too
+                # (few huge ops never trip the op count)
+                hard_cap_bytes=hot_bytes * max(
+                    2, _env_int("GRAFT_OPLOG_HOT_HARD_MULT", 8))
+                if hot_bytes > 0 else 0)
 
     def _init_durable(self, engine: "ServingEngine",
                       max_depth: int) -> None:
@@ -270,15 +308,62 @@ class ServedDoc:
         publish just retired, stamped on the commit's flight record.
         Under fault injection only, the outgoing snapshot is retained
         one generation as the stale/regress target (obs/oracle.py)."""
+        self._prepared_seq += 1
+        return self.publish_prepared(snapshot_mod.derive(
+            self.doc_id, self._prepared_seq, self.tree))
+
+    def prepare_publish(self) -> snapshot_mod.DocSnapshot:
+        """Pipelined commit path, compute half (scheduler thread):
+        derive — but do NOT publish — the snapshot this commit's fsync
+        will publish.  The derived snapshot is immutable and pins a
+        reference-stable ``LogView``, so the WAL-sync worker's later
+        :meth:`publish_prepared` is a pointer swap that cannot race
+        the merges the scheduler runs meanwhile.  A shed commit's
+        prepared snapshot is simply discarded (seq gaps are legal —
+        monotonicity is all readers rely on)."""
+        self._prepared_seq += 1
+        return snapshot_mod.derive(self.doc_id, self._prepared_seq,
+                                   self.tree)
+
+    def publish_prepared(self, snap: snapshot_mod.DocSnapshot) -> float:
+        """Swap in a :meth:`prepare_publish` snapshot — the
+        linearization point, called by whichever thread completed the
+        commit's fsync (WAL-sync worker, or the scheduler itself on
+        the serialized path via :meth:`publish`)."""
         staleness = self._snap.age_s()
         if self._engine.fault is not None:
             # only fault injection ever serves the previous generation
             # (read_view); in production retaining it would double the
             # per-document snapshot footprint for nothing
             self._prev_snap = self._snap
-        self._seq += 1
-        self._snap = snapshot_mod.derive(self.doc_id, self._seq, self.tree)
+        self._seq = snap.seq
+        self._snap = snap
         return staleness
+
+    def safe_extent(self) -> int:
+        """The log extent no failed group fsync can roll back — the
+        background maintenance worker's spill bound."""
+        return self._safe_extent
+
+    def note_durable(self, log_len: int,
+                     matz_check: bool = True) -> None:
+        """A commit through ``log_len`` fully resolved (fsynced, or
+        not WAL-deferred at all): advance the spill-safe extent, and
+        check the matz cadence — a due refresh raises ``_matz_due``
+        for the scheduler's next safe pickup (the pipelined twin of
+        :meth:`maybe_write_matz`)."""
+        if log_len > self._safe_extent:
+            self._safe_extent = log_len
+        if not matz_check or self._matz_due:
+            return
+        if self.wal is None or self._engine.matz_tail_ops <= 0 \
+                or not engine_mod.matz_enabled() \
+                or not self.tree._log.tiering_enabled:
+            return
+        entry = self.tree._log.matz_entry
+        covered = int(entry["len"]) if entry is not None else 0
+        if log_len - covered >= self._engine.matz_tail_ops:
+            self._matz_due = True
 
     def snapshot_view(self) -> snapshot_mod.DocSnapshot:
         """The current published snapshot (lock-free)."""
@@ -395,10 +480,12 @@ class ServingEngine:
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
                  wal_shared: Optional[bool] = None,
+                 pipeline: Optional[bool] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
                  start: bool = True):
         from .scheduler import MergeScheduler
+        from .workers import MaintenanceWorker, WalSyncWorker
         self._docs: Dict[str, ServedDoc] = {}
         self._lock = threading.Lock()
         self._max_depth = max_depth
@@ -494,7 +581,42 @@ class ServingEngine:
         # a SessionOracle attached via oracle.attach_engine() — renders
         # the crdt_oracle_* prom families when present
         self.oracle: Optional[oracle_mod.SessionOracle] = None
+        # -- pipelined commit path (serve/workers.py; ISSUE 12) ----------
+        # GRAFT_PIPELINE=0 restores the fully serialized scheduler
+        # (every round: compute → fsync → publish → maintenance on one
+        # thread) — the A/B baseline and the conservative fallback.
+        if pipeline is None:
+            pipeline = os.environ.get(
+                "GRAFT_PIPELINE", "1").strip() not in ("", "0")
+        self.pipeline = bool(pipeline)
+        # size/age spill-policy knobs (maintenance worker policy tick)
+        self.oplog_hot_age_s = _env_float("GRAFT_OPLOG_HOT_AGE_S", 0.0)
+        self.oplog_resident_bytes = _env_int(
+            "GRAFT_OPLOG_RESIDENT_MB", 0) << 20
+        # inline-spill hard cap: past this many resident hot ops the
+        # scheduler spills inline even with the worker armed — memory
+        # stays bounded no matter how far the worker lags
+        self.oplog_hot_hard_ops = max(1, self.oplog_hot_ops) * max(
+            2, _env_int("GRAFT_OPLOG_HOT_HARD_MULT", 8))
+        self.maintenance = None
+        self.sync_worker = None
+        if self.pipeline and (self.oplog_hot_ops > 0
+                              or self.durable_dir is not None):
+            self.maintenance = MaintenanceWorker(self)
+        if self.pipeline and self.durable_dir is not None \
+                and self.wal_sync == "batch":
+            self.sync_worker = WalSyncWorker(self)
+        if self.shared_wal is not None and self.maintenance is not None:
+            maint = self.maintenance
+            self.shared_wal.set_compact_cb(
+                lambda: maint.enqueue("compact"))
         self.scheduler = MergeScheduler(self)
+        # workers start before recovery: recovered docs arm their
+        # spill policies against them at construction
+        if self.maintenance is not None:
+            self.maintenance.start()
+        if self.sync_worker is not None:
+            self.sync_worker.start()
         # recovery-to-serving: reopen every durable document found on
         # disk NOW, so a restarted server answers reads (and accepts
         # writes at its bumped epoch) immediately instead of 404ing
@@ -636,7 +758,12 @@ class ServingEngine:
             self.counters.add("fault_dropped_commits")
             return
         audit = None
-        if (ct.packed is not None and ct.outcome in
+        if ct.audit_sampled:
+            # pipelined commit: the sample already ran on the
+            # scheduler thread at prepare time (presample_audit) —
+            # the WAL-sync worker must never trace jaxprs
+            audit = ct.audit_result
+        elif (ct.packed is not None and ct.outcome in
                 ("committed", "partial")
                 and self.flight.audit_due(ct.num_ops)):
             from ..utils import chainaudit
@@ -682,6 +809,27 @@ class ServingEngine:
         except Exception:            # noqa: BLE001 — recorder boundary
             self.counters.add("flight_record_errors")
 
+    def presample_audit(self, ct: trace_mod.CommitTrace) -> None:
+        """Pipelined rounds sample the chain audit on the SCHEDULER
+        thread at prepare time (jaxpr tracing must never run
+        concurrently with the scheduler's kernel launches from the
+        WAL-sync worker); :meth:`record_commit` then uses the stored
+        result."""
+        if ct.audit_sampled:
+            return
+        ct.audit_sampled = True
+        ct.audit_result = None
+        if (ct.packed is not None and ct.outcome in
+                ("committed", "partial")
+                and self.flight.audit_due(ct.num_ops)):
+            from ..utils import chainaudit
+            try:
+                with ct.stage("audit_sample"):
+                    ct.audit_result = \
+                        chainaudit.audit_packed_summary(ct.packed)
+            except Exception as e:   # noqa: BLE001 — tripwire sampling
+                ct.audit_result = {"sample_error": repr(e)}
+
     # -- lifecycle / observability ---------------------------------------
 
     def scheduler_metrics(self) -> Dict:
@@ -694,6 +842,14 @@ class ServingEngine:
             len(d.queue) for d in self.docs())
         out["spans"] = profiling.span_stats("serve.")
         out["flight"] = self.flight.stats()
+        # pipelined commit path + maintenance lane (serve/workers.py)
+        out["pipeline"] = {
+            "enabled": self.sync_worker is not None,
+            **(self.sync_worker.stats()
+               if self.sync_worker is not None else {}),
+        }
+        out["maintenance"] = None if self.maintenance is None \
+            else self.maintenance.stats()
         return out
 
     def render_prom(self) -> str:
@@ -721,8 +877,16 @@ class ServingEngine:
     def close(self, timeout: float = 10.0) -> None:
         """Stop the scheduler and fail any unresolved tickets (503) —
         clean shutdown never leaves a handler thread blocked.  The
-        documents' ephemeral spill tiers are deleted with the engine."""
+        documents' ephemeral spill tiers are deleted with the engine.
+        Pipeline lanes stop IN ORDER: scheduler (no new rounds), then
+        the WAL-sync worker (queued fsyncs drain — their acks must
+        still resolve), then maintenance (abandons its queue:
+        spill/fold/export work is idempotent and re-derivable)."""
         self.scheduler.shutdown(timeout=timeout)
+        if self.sync_worker is not None:
+            self.sync_worker.stop(timeout=timeout)
+        if self.maintenance is not None:
+            self.maintenance.stop(timeout=timeout)
         for d in self.docs():
             try:
                 d.tree._log.close()

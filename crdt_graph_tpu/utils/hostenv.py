@@ -30,6 +30,15 @@ def env_int(name: str, default: int) -> int:
         return default
 
 
+def env_float(name: str, default: float) -> float:
+    """Float twin of :func:`env_int` (GRAFT_OPLOG_HOT_AGE_S and the
+    obs/flight.py timing knobs)."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def flag_on(name: str, default: str = "1") -> bool:
     """One boolean env flag, read at TRACE time and logged on every
     (re)trace — the single parser behind the GRAFT_FUSED_* and

@@ -78,9 +78,20 @@ _DOC = struct.Struct(">H")   # doc-id length, first 2 shared-payload bytes
 # layer caps request bodies at 128 MB; columns add < 2x)
 MAX_RECORD_BYTES = 1 << 30
 
-# the deterministic kill sites (docs/DURABILITY.md §Crash-point matrix)
+# the deterministic kill sites (docs/DURABILITY.md §Crash-point matrix).
+# "pre-queue-fsync" fires on the PIPELINED scheduler between a round's
+# merge compute (records appended, unsynced) and queueing the round to
+# the WAL-sync worker; "mid-bg-fold" fires on the background
+# tier-maintenance worker between a spill and its fold/GC pass — both
+# prove the two-stage commit pipeline (serve/workers.py) holds the
+# zero-acked-loss contract at its new thread boundaries.
 CRASH_SITES = ("ack-pre-fsync", "post-fsync-pre-publish", "mid-spill",
-               "mid-fold", "mid-manifest-write", "mid-matz-write")
+               "mid-fold", "mid-manifest-write", "mid-matz-write",
+               "pre-queue-fsync", "mid-bg-fold")
+
+# sites that can only fire on the pipelined commit path (GRAFT_PIPELINE
+# armed) — the serialized crash matrix legitimately skips them
+PIPELINE_ONLY_SITES = ("pre-queue-fsync", "mid-bg-fold")
 
 SYNC_MODES = ("commit", "batch", "off")
 
@@ -205,6 +216,26 @@ def _decode_shared_payload(payload: bytes) -> Tuple[str, int, Any]:
         raise WalError(f"crc-valid shared WAL record failed to "
                        f"decode: {e}") from e
     return doc_id, end_pos, p
+
+
+def encode_record(p, end_pos: int) -> bytes:
+    """One commit's full per-doc WAL record (header + payload), ready
+    for :meth:`Wal.append_encoded`.  The pipelined scheduler encodes
+    during a round's compute (the CPU half, safe to discard on a
+    shed) and lands the bytes at the round barrier, strictly after
+    the previous round's fsync resolved — so a failed group fsync can
+    never leave a later round's already-appended record describing
+    ops the shed rollback destroyed."""
+    payload = _encode_payload(p, end_pos)
+    return _HDR.pack(len(payload),
+                     zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def encode_shared_record(doc_id: str, p, end_pos: int) -> bytes:
+    """Shared-stream twin of :func:`encode_record`."""
+    payload = _encode_shared_payload(doc_id, p, end_pos)
+    return _HDR.pack(len(payload),
+                     zlib.crc32(payload) & 0xFFFFFFFF) + payload
 
 
 def _scan_raw(path: str, magic: bytes
@@ -376,9 +407,16 @@ class Wal:
         retry applies for real once the disk recovers.  A failed
         append repairs the file back to the last good record boundary
         so the partial bytes can never be buried mid-log."""
-        payload = _encode_payload(p, end_pos)
-        rec = _HDR.pack(len(payload),
-                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        self.append_encoded(encode_record(p, end_pos))
+
+    def encode(self, p, end_pos: int) -> bytes:
+        """Pre-encode one record for the pipelined barrier append
+        (module :func:`encode_record`, bound for facade symmetry)."""
+        return encode_record(p, end_pos)
+
+    def append_encoded(self, rec: bytes) -> None:
+        """Append one pre-encoded record (:func:`encode_record`) —
+        same error contract as :meth:`append`."""
         with self._mu:
             if self._dirty:
                 self._repair_locked(self._size)
@@ -597,6 +635,11 @@ class SharedWal:
         self._last_compact_size = 0
         self._opened_once = False
         self._dirty = False
+        # pipelined mode (serve/workers.py): a due compaction is
+        # HANDED to the maintenance worker instead of rewriting the
+        # stream on the scheduler thread mid-round
+        self._compact_cb: Optional[Any] = None
+        self._compact_queued = False
 
     def _histogram(self, which: str):
         from .serve.metrics import (LATENCY_BOUNDS_MS, WIDTH_BOUNDS,
@@ -660,9 +703,12 @@ class SharedWal:
         and sheds THAT commit (other documents' already-appended
         records this round stay intact — the repair truncates only
         the failed append's partial bytes)."""
-        payload = _encode_shared_payload(doc_id, p, end_pos)
-        rec = _HDR.pack(len(payload),
-                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        self.append_encoded(encode_shared_record(doc_id, p, end_pos))
+
+    def append_encoded(self, rec: bytes) -> None:
+        """Append one pre-encoded shared record
+        (:func:`encode_shared_record`) — same error contract as
+        :meth:`append`."""
         with self._mu:
             if self._dirty:
                 self._repair_locked(self._size)
@@ -733,7 +779,33 @@ class SharedWal:
                     pass
             if self._size < max(1 << 20, 2 * self._last_compact_size):
                 return 0
-            return self._compact_locked()
+            if self._compact_cb is None:
+                return self._compact_locked()
+            if self._compact_queued:
+                return 0
+            self._compact_queued = True
+            cb = self._compact_cb
+        # deferred: the rewrite (scan + re-CRC of every live record)
+        # runs on the maintenance worker, off the thread that crossed
+        # the threshold.  The cb returns False when the worker's
+        # bounded queue refused — the latch must reset either way or
+        # a single full-queue moment would disable compaction forever
+        try:
+            ok = bool(cb())
+        except Exception:   # noqa: BLE001 — worker-queue boundary
+            ok = False
+        if not ok:
+            with self._mu:
+                self._compact_queued = False
+        return 0
+
+    def set_compact_cb(self, cb) -> None:
+        """Defer threshold-triggered compactions to ``cb`` (the
+        maintenance worker's enqueue, serve/workers.py) instead of
+        rewriting the stream inline on whatever thread crossed the
+        threshold."""
+        with self._mu:
+            self._compact_cb = cb
 
     def compact(self) -> int:
         """Force a stream compaction now (tests / shutdown hygiene)."""
@@ -741,6 +813,7 @@ class SharedWal:
             return self._compact_locked()
 
     def _compact_locked(self) -> int:
+        self._compact_queued = False
         if self._f is not None:
             self._f.flush()
         try:
@@ -879,6 +952,17 @@ class DocWalView:
         # doc without new plumbing in the shared append path
         b0 = self.shared.appended_bytes
         self.shared.append(self.doc_id, p, end_pos)
+        self.appends += 1
+        self.appended_bytes += self.shared.appended_bytes - b0
+
+    def encode(self, p, end_pos: int) -> bytes:
+        """Pre-encode one record for the pipelined barrier append
+        (the per-doc facade's twin of :func:`encode_record`)."""
+        return encode_shared_record(self.doc_id, p, end_pos)
+
+    def append_encoded(self, rec: bytes) -> None:
+        b0 = self.shared.appended_bytes
+        self.shared.append_encoded(rec)
         self.appends += 1
         self.appended_bytes += self.shared.appended_bytes - b0
 

@@ -1,0 +1,368 @@
+"""Pipelined commit path (ISSUE 12): the two-stage scheduler/WAL-sync
+pipeline and the background tier-maintenance worker.
+
+The contract under test: pipelining is a PURE latency-overlap
+optimization — byte-identical serving state and windows vs the
+serialized path, the same fsync-before-ack durability point, rollback
+of every covered commit (across rounds) on a failed fsync, a flush()
+barrier that covers the pipeline's deferred work too, and spill
+policies (deferral, hard-cap inline fallback, age, engine-wide
+resident bytes) that keep memory bounded without ever touching rows a
+failed fsync could still roll back.
+"""
+import threading
+import time
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu import engine
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.obs import flight as flight_mod
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.serve import ServingEngine, WalUnavailable
+
+OFF = 2**32
+
+
+def ts(r, c):
+    return r * OFF + c
+
+
+def chain_ops(r, n, start=1):
+    out = []
+    prev = ts(r, start - 1) if start > 1 else 0
+    for c in range(start, start + n):
+        out.append(Add(ts(r, c), (prev,), f"v{r}.{c}"))
+        prev = ts(r, c)
+    return out
+
+
+def _submit(eng, doc, ops):
+    return eng.submit(doc, json_codec.dumps(Batch(tuple(ops))))
+
+
+def _engine(ddir, pipeline, **kw):
+    kw.setdefault("oplog_hot_ops", 8)
+    kw.setdefault("flight", flight_mod.FlightRecorder())
+    return ServingEngine(durable_dir=str(ddir), wal_sync="batch",
+                         pipeline=pipeline, **kw)
+
+
+def test_pipeline_ab_bit_identical_fingerprints_and_windows(tmp_path):
+    """Interleaved A/B: the same write sequence through the pipelined
+    and the serialized engine publishes bit-identical fingerprints
+    (seq included — same commit count) and byte-identical sync windows
+    at every tier seam, even though the physical spill timing differs
+    (background vs inline)."""
+    engines = {
+        True: _engine(tmp_path / "p1", True),
+        False: _engine(tmp_path / "p0", False),
+    }
+    assert engines[True].sync_worker is not None
+    assert engines[False].sync_worker is None
+    ops = chain_ops(1, 60)
+    for i in range(0, 60, 6):
+        for pipe in (True, False):      # interleaved, not sequential
+            ok, _ = _submit(engines[pipe], "ab", ops[i:i + 6])
+            assert ok
+    for eng in engines.values():
+        assert eng.flush(30)
+    docs = {p: e.get("ab") for p, e in engines.items()}
+    s1, s0 = docs[True].snapshot_view(), docs[False].snapshot_view()
+    assert s1.fingerprint() == s0.fingerprint()
+    assert s1.state_fingerprint() == s0.state_fingerprint()
+    assert s1.seq == s0.seq and s1.log_length == s0.log_length
+    # windows byte-identical at hot/cold/base seams, pinned both ways
+    for since in (0, ts(1, 1), ts(1, 9), ts(1, 31), ts(1, 55)):
+        for limit in (0, 7):
+            if limit:
+                b1, m1 = s1.ops_since_window(since, limit)
+                b0, m0 = s0.ops_since_window(since, limit)
+                assert b1 == b0 and m1 == m0, (since, limit)
+            else:
+                assert s1.ops_since_bytes(since) == \
+                    s0.ops_since_bytes(since), since
+    # the pipelined leg really pipelined (rounds rode the worker) and
+    # really deferred maintenance (spills ran on the worker)
+    assert engines[True].sync_worker.stats()["jobs_done"] >= 1
+    assert engines[True].maintenance.stats()["tasks_done"].get(
+        "spill", 0) >= 1
+    for e in engines.values():
+        e.close()
+
+
+def test_flush_true_means_pipeline_lanes_drained(tmp_path):
+    """ISSUE 12 satellite: flush() == True must mean every queued
+    fsync resolved AND the maintenance queue drained — not just that
+    the tickets resolved (the old barrier only joined the scheduler
+    round)."""
+    eng = _engine(tmp_path / "dur", True)
+    for i in range(0, 48, 6):
+        ok, _ = _submit(eng, "fdoc", chain_ops(1, 48)[i:i + 6])
+        assert ok
+    assert eng.flush(30)
+    # by construction: both lanes idle the moment flush returns True
+    assert eng.sync_worker.idle()
+    assert eng.maintenance.idle()
+    doc = eng.get("fdoc")
+    # the deferred spills actually landed (hot tail back under budget)
+    assert doc.tree._log.hot_len <= 8 + 8 // 4
+    assert doc.safe_extent() == doc.tree.log_length
+    # pipelined stage split present on committed records
+    rec = [r for r in eng.flight.records()
+           if r.outcome == "committed"][-1]
+    assert "wal_fsync" in rec.stages_ms
+    assert "wal_fsync_queued" in rec.stages_ms
+    # telemetry surfaces strict-parse clean
+    fams = prom_mod.parse_text(eng.render_prom())
+    for fam in ("crdt_sched_pipeline_enabled",
+                "crdt_sched_pipeline_rounds_total",
+                "crdt_sched_pipeline_commits_synced_total",
+                "crdt_sched_pipeline_inflight",
+                "crdt_maint_queue_depth", "crdt_maint_tasks_total",
+                "crdt_maint_inline_spill_fallbacks_total"):
+        assert fam in fams, fam
+    sm = eng.scheduler_metrics()
+    assert sm["pipeline"]["enabled"] and sm["maintenance"] is not None
+    # a paused scheduler with pending work still refuses the barrier
+    eng.scheduler.pause()
+    t = threading.Thread(
+        target=lambda: _submit(eng, "fdoc", chain_ops(9, 1)),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and not len(eng.get("fdoc").queue):
+        time.sleep(0.01)
+    assert not eng.flush(1.0)
+    eng.scheduler.resume()
+    t.join(20)
+    assert eng.flush(30)
+    eng.close()
+
+
+def test_failed_pipelined_fsync_rolls_back_both_rounds(tmp_path):
+    """A failed fsync sheds EVERY commit it dooms — including the
+    NEXT round's commit on the same document, which the scheduler
+    already merged while the fsync was in flight (it causally sits on
+    top of the doomed ops).  Both clients get the honest 503, the
+    tree rolls back to the earliest doomed commit's pre-state, and
+    the server keeps serving."""
+    eng = _engine(tmp_path / "dur", True, submit_timeout_s=30.0)
+    ok, _ = _submit(eng, "doc", chain_ops(1, 4))
+    assert ok
+    assert eng.flush(30)
+    doc = eng.get("doc")
+    vals = doc.snapshot()
+
+    real_sync = doc.wal.sync
+    release = threading.Event()
+
+    def blocked_sync():
+        # hold round N's fsync open until round N+1 has computed,
+        # then fail it — the deterministic cross-round overlap
+        release.wait(20)
+        raise OSError(28, "No space left on device")
+
+    doc.wal.sync = blocked_sync
+    results = {}
+
+    def writer(name, ops):
+        try:
+            results[name] = _submit(eng, "doc", ops)
+        except WalUnavailable:
+            results[name] = "shed"
+
+    ta = threading.Thread(target=writer,
+                          args=("a", chain_ops(1, 4, start=5)),
+                          daemon=True)
+    ta.start()
+    # wait until round N's job is in flight (worker blocked in sync)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and eng.sync_worker.stats()["inflight"] == 0:
+        time.sleep(0.01)
+    assert eng.sync_worker.stats()["inflight"] == 1
+    tb = threading.Thread(target=writer,
+                          args=("b", chain_ops(1, 4, start=9)),
+                          daemon=True)
+    tb.start()
+    # round N+1 must have merged b's ops on top of the doomed round
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and doc.tree.log_length < 12:
+        time.sleep(0.01)
+    assert doc.tree.log_length == 12
+    doc.wal.sync = real_sync
+    release.set()
+    ta.join(30)
+    tb.join(30)
+    assert results == {"a": "shed", "b": "shed"}, results
+    # rolled back to the EARLIEST doomed commit's pre-state
+    assert doc.tree.log_length == 4
+    assert doc.snapshot() == vals
+    assert eng.scheduler.is_alive()
+    assert eng.counters.snapshot().get("pipeline_shed_commits", 0) >= 2
+    # disk back: the whole chain re-applies for real
+    ok, _ = _submit(eng, "doc", chain_ops(1, 8, start=5))
+    assert ok
+    assert doc.tree.log_length == 12
+    assert eng.flush(30)
+    eng.close()
+
+
+def test_age_spill_policy_drains_idle_hot_tails(tmp_path, monkeypatch):
+    """GRAFT_OPLOG_HOT_AGE_S: an idle document's hot tail is swept to
+    cold by the maintenance worker's policy tick even though it never
+    crossed the size budget."""
+    monkeypatch.setenv("GRAFT_OPLOG_HOT_AGE_S", "0.2")
+    eng = _engine(tmp_path / "dur", True, oplog_hot_ops=4096)
+    ok, _ = _submit(eng, "aged", chain_ops(1, 12))
+    assert ok
+    doc = eng.get("aged")
+    assert doc.tree._log.tiered_extent == 0    # under the size budget
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and doc.tree._log.tiered_extent < 12:
+        time.sleep(0.05)
+    assert doc.tree._log.tiered_extent == 12, \
+        doc.tree._log.telemetry()
+    assert doc.tree._log.hot_len == 0
+    assert eng.maintenance.stats()["policy_age_spills"] >= 1
+    # serving state untouched by the sweep
+    assert len(doc.snapshot()) == 12
+    eng.close()
+
+
+def test_resident_budget_policy_spills_largest_first(tmp_path,
+                                                     monkeypatch):
+    """GRAFT_OPLOG_RESIDENT_MB: when the engine-wide hot-resident
+    total exceeds the budget, the policy drains the LARGEST hot tails
+    first."""
+    monkeypatch.setenv("GRAFT_OPLOG_RESIDENT_MB", "1")
+    eng = _engine(tmp_path / "dur", True, oplog_hot_ops=1 << 20)
+    big = [Add(ts(1, c), (ts(1, c - 1) if c > 1 else 0,), "x" * 200)
+           for c in range(1, 8001)]
+    for i in range(0, 8000, 1000):
+        ok, _ = _submit(eng, "big", big[i:i + 1000])
+        assert ok
+    ok, _ = _submit(eng, "small", chain_ops(2, 10))
+    assert ok
+    bigdoc, smalldoc = eng.get("big"), eng.get("small")
+    # (no pre-assert on hot_bytes: the policy tick may already have
+    # begun draining it — exactly the behavior under test)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and bigdoc.tree._log.tiered_extent == 0:
+        time.sleep(0.05)
+    assert bigdoc.tree._log.tiered_extent > 0, \
+        bigdoc.tree._log.telemetry()
+    assert eng.maintenance.stats()["policy_resident_spills"] >= 1
+    # the small doc was not the victim
+    assert smalldoc.tree._log.tiered_extent == 0
+    eng.close()
+
+
+def test_hard_cap_inline_spill_fallback_bounds_memory(tmp_path,
+                                                      monkeypatch):
+    """When the maintenance worker lags (here: its queue refuses), a
+    hot tail past the hard cap spills INLINE on the scheduler —
+    resident memory stays bounded no matter what, and the fallback is
+    counted."""
+    monkeypatch.setenv("GRAFT_OPLOG_HOT_HARD_MULT", "2")
+    eng = _engine(tmp_path / "dur", True)       # hot_ops=8, cap=16
+    maint = eng.maintenance
+    monkeypatch.setattr(maint, "enqueue",
+                        lambda *a, **k: False)  # worker "full"
+    for i in range(0, 60, 6):
+        ok, _ = _submit(eng, "cap", chain_ops(1, 60)[i:i + 6])
+        assert ok
+    doc = eng.get("cap")
+    assert maint.stats()["inline_spill_fallbacks"] >= 1
+    # bounded: the tail never grew far past the cap
+    assert doc.tree._log.hot_len <= 16 + 6
+    assert doc.tree._log.tiered_extent > 0
+    eng.close()
+
+
+def test_pipeline_recovery_matches_serialized(tmp_path):
+    """A pipelined engine's durable dir restores to the same serving
+    state a serialized engine's does — recovery is mode-blind."""
+    dirs = {p: tmp_path / f"r{int(p)}" for p in (True, False)}
+    vals = {}
+    for pipe, d in dirs.items():
+        eng = _engine(d, pipe)
+        for i in range(0, 30, 5):
+            ok, _ = _submit(eng, "rdoc", chain_ops(1, 30)[i:i + 5])
+            assert ok
+        assert eng.flush(30)
+        vals[pipe] = eng.get("rdoc").snapshot()
+        eng.close()
+    assert vals[True] == vals[False]
+    restored = {}
+    for pipe, d in dirs.items():
+        # recover each dir under the OPPOSITE mode: on-disk state is
+        # mode-portable (same WAL format, same tiers)
+        eng = _engine(d, not pipe)
+        doc = eng.get("rdoc", create=False)
+        assert doc is not None and doc.recovered
+        restored[pipe] = doc.snapshot()
+        sv = doc.snapshot_view()
+        restored[f"fp{pipe}"] = sv.state_fingerprint()
+        eng.close()
+    assert restored[True] == restored[False] == vals[True]
+    assert restored["fpTrue"] == restored["fpFalse"]
+
+
+@pytest.mark.slow
+def test_bench_pipeline_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_PIPELINE_r01_cpu.json shape,
+    reduced): the pipelined leg beats the serialized baseline on
+    acked throughput with zero oracle violations both legs.  The
+    committed artifact holds the honest ≥1.5× number; the reduced
+    gate is looser against 1-core scheduling noise."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "_bench_pipeline_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_pipeline_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_PIPELINE_test.json"),
+                  n_sessions=32, n_docs=32, writes_per_session=4,
+                  rounds=1)
+    best = out["best"]
+    for leg in ("pipelined", "serialized"):
+        assert best[leg]["violations"] == 0
+        assert best[leg]["writes_acked"] >= 32 * 4
+        assert best[leg]["wal"]["fsyncs"] >= 1, leg
+    # correctness is the hard gate here; the throughput bound is a
+    # broken-pipeline tripwire only (the committed artifact holds the
+    # honest ≥1.5× A/B — a contended CI box can squeeze the reduced
+    # shape's overlap to near-parity, which must not read as red)
+    assert out["pipelined_vs_serialized_speedup"] >= 0.8
+    # and the pipeline really ran
+    assert best["pipelined"]["pipeline"]["commits_synced"] > 0
+
+
+def test_engine_without_durability_still_gets_maintenance(tmp_path):
+    """Non-durable serving engines (ephemeral tiering) have no WAL to
+    pipeline but still move spills off the scheduler thread."""
+    eng = ServingEngine(oplog_hot_ops=8,
+                        flight=flight_mod.FlightRecorder())
+    assert eng.sync_worker is None and eng.maintenance is not None
+    for i in range(0, 40, 5):
+        ok, _ = _submit(eng, "edoc", chain_ops(1, 40)[i:i + 5])
+        assert ok
+    assert eng.flush(30)
+    doc = eng.get("edoc")
+    assert doc.tree._log.tiered_extent > 0
+    assert eng.maintenance.stats()["tasks_done"].get("spill", 0) >= 1
+    assert len(doc.snapshot()) == 40
+    eng.close()

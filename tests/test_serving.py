@@ -139,9 +139,12 @@ def _reader_soak(n_merge_ops, reader_seconds_after=0.0):
 
 def test_concurrent_readers_during_merge_soak():
     """Readers never block on (or observe) an in-flight merge: while a
-    200k-op catch-up merge commits, every read returns a complete,
-    monotonically advancing snapshot, p99 under 10 ms."""
-    lat_ms, _ = _reader_soak(200_000)
+    multi-chunk catch-up merge commits, every read returns a complete,
+    monotonically advancing snapshot, p99 under 10 ms.  (140k ops = 2
+    chunks — the smallest shape that still exercises mid-chunk reads;
+    the slow 1M variant below holds the acceptance scale, ISSUE 12
+    tier-1 budget.)"""
+    lat_ms, _ = _reader_soak(140_000)
     assert lat_ms, "no reads observed during the merge window"
     lat_ms.sort()
     p99 = lat_ms[(99 * len(lat_ms)) // 100 - 1] if len(lat_ms) >= 100 \

@@ -337,20 +337,37 @@ def test_disk_full_sheds_503_and_server_stays_up(tmp_path):
 # -- the crash-point matrix (deterministic, in-process) --------------------
 
 
+# sites where the kill legitimately races the ack: the work that
+# crashes runs AFTER ticket resolution.  Serialized: only the matz
+# export runs post-ack.  Pipelined: spill/fold/manifest/matz all
+# moved to the background maintenance worker, which by construction
+# only ever touches fsync-durable (hence ack-resolved) rows.
+_POST_ACK_SITES = {
+    False: {"mid-matz-write"},
+    True: {"mid-matz-write", "mid-spill", "mid-fold",
+           "mid-manifest-write", "mid-bg-fold"},
+}
+
+
+@pytest.mark.parametrize("pipeline", (False, True),
+                         ids=("serial", "pipelined"))
 @pytest.mark.parametrize("shared", (False, True),
                          ids=("perdoc", "shared"))
 @pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
 def test_crash_point_matrix_zero_acked_loss(tmp_path, site, shared,
-                                            monkeypatch):
-    """One kill site per run — × the per-doc AND shared WAL streams:
-    acked writes survive, the recovered doc serves immediately at a
-    bumped epoch, windows stay byte-identical, and the oracle's
-    convergence check reports zero violations over the recovered
-    serving surface.  In-process kill: the CrashPoint BaseException
-    stops the scheduler exactly at the site (nothing after it runs —
-    no fsync, no publish, no ack) and everything already
-    ``write()``-en survives in the page cache, which is precisely the
-    post-SIGKILL disk state."""
+                                            pipeline, monkeypatch):
+    """One kill site per run — × {per-doc, shared} WAL streams × the
+    {serialized, pipelined} commit paths: acked writes survive, the
+    recovered doc serves immediately at a bumped epoch, windows stay
+    byte-identical, and the oracle's convergence check reports zero
+    violations over the recovered serving surface.  In-process kill:
+    the CrashPoint BaseException stops the thread that hit the site
+    exactly there (nothing after it runs — no fsync, no publish, no
+    ack on that path), the other pipeline threads die at their next
+    check, and everything already ``write()``-en survives in the page
+    cache, which is precisely the post-SIGKILL disk state."""
+    if not pipeline and site in wal_mod.PIPELINE_ONLY_SITES:
+        pytest.skip("site only exists on the pipelined commit path")
     monkeypatch.setenv("GRAFT_OPLOG_GC_SEGS", "1")
     # a tiny materialization cadence so the armed commit also crosses
     # the matz refresh (the mid-matz-write site must actually fire,
@@ -358,17 +375,21 @@ def test_crash_point_matrix_zero_acked_loss(tmp_path, site, shared,
     monkeypatch.setenv("GRAFT_MATZ_TAIL_OPS", "8")
     ddir = tmp_path / "dur"
     eng = _durable_engine(ddir, submit_timeout_s=2.0,
-                          wal_shared=shared)
+                          wal_shared=shared, pipeline=pipeline)
     acked = []
     ops = chain_ops(1, 80)
     for i in range(0, 15, 5):
         ok, _ = _submit(eng, "doc", ops[i:i + 5])
         assert ok
         acked.extend(ops[i:i + 5])
+    # barrier over the pipeline lanes so the setup writes' background
+    # spills/exports are done BEFORE the site arms (the doomed write
+    # below must be the one that trips it)
+    assert eng.flush(30)
     monkeypatch.setenv("GRAFT_CRASH_POINT", site)
     # a 20-leaf commit from a 15-op log with hot_ops=8 forces spill →
     # fold (gc_min_segs=1) → manifest in the armed commit, so every
-    # site fires on this one write; the ack must never come back
+    # site fires on this one write
     crashed = {}
 
     def doomed():
@@ -379,16 +400,14 @@ def test_crash_point_matrix_zero_acked_loss(tmp_path, site, shared,
 
     th = threading.Thread(target=doomed, daemon=True)
     th.start()
-    eng.scheduler.join(20)
+    eng.scheduler.join(30)
     assert not eng.scheduler.is_alive(), \
         f"site {site} never fired (scheduler survived)"
     th.join(10)
-    if site == "mid-matz-write":
-        # the artifact export runs AFTER ticket resolution (it must
-        # never sit between a client and its ack), so this site fires
-        # post-ack: the doomed commit's ack legitimately races the
-        # crash — and if it landed, it is already fsynced and must
-        # survive recovery like any other acked write
+    if site in _POST_ACK_SITES[pipeline]:
+        # post-ack work: the doomed commit's ack legitimately races
+        # the crash — and if it landed, it is already fsynced and
+        # must survive recovery like any other acked write
         if crashed.get("ack") and crashed["ack"][0]:
             acked.extend(ops[15:35])
     else:
@@ -557,9 +576,11 @@ def test_recovered_doc_first_read_from_matz_flight_and_prom(
         assert ok
     doc = eng.get("mdoc")
     vals = doc.snapshot()
+    # pipelined: the artifact export rides the maintenance worker —
+    # flush() barriers over it (due pickups included) by contract
+    assert eng.flush(30)
     assert doc.tree.matz_stats["writes"] >= 1
     assert doc.tree._log.matz_entry is not None
-    assert eng.flush(30)
     # abandon un-closed; recover
     eng2 = _durable_engine(ddir)
     doc2 = eng2.get("mdoc", create=False)
@@ -875,7 +896,7 @@ def _proc_env():
     "site,shared",
     [(s, False) for s in wal_mod.CRASH_SITES]
     + [("ack-pre-fsync", True), ("post-fsync-pre-publish", True),
-       ("mid-matz-write", True)])
+       ("mid-matz-write", True), ("pre-queue-fsync", True)])
 def test_wal_crash_point_process_matrix(tmp_path, site, shared):
     """The real thing: a server process dies by os._exit(137) at the
     armed site mid-HTTP-traffic; a fresh engine recovers the durable
