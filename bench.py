@@ -140,6 +140,9 @@ def _child() -> None:
         # budget verdict): keeps the perf trajectory attached to the
         # cost model even when this row is a CPU-fallback number
         "chain_audit": stats.get("chain_audit"),
+        # ops-axis sharded-trace audit (ISSUE 13): per-shard width vs
+        # the ceil(M/k)+halo budget, collective bytes, crowding leg
+        "opsaxis": stats.get("opsaxis"),
     }), flush=True)
 
 

@@ -479,7 +479,24 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
             f for f in fams if f.startswith("crdt_oracle_")),
         "errors": errors[:8],
         "flight": engine.flight.stats(),
+        # ops-axis sharded-merge routing (ISSUE 13): the runtime
+        # counters plus — when any merge routed — the shard audit of
+        # the last routed shape ({devices, shard_width, halo_rows,
+        # collective_bytes, leg}), chain_audit-style and never fatal
+        "opsaxis": _opsaxis_report(),
     }
+    return out
+
+
+def _opsaxis_report():
+    from ..parallel import opsaxis
+    out = opsaxis.stats()
+    try:
+        audit = opsaxis.audit_last()
+    except Exception as e:  # pragma: no cover - disclosure over failure
+        audit = {"error": repr(e)[:200]}
+    if audit is not None:
+        out["audit"] = audit
     return out
 
 

@@ -150,6 +150,15 @@ def time_merge(ops: Dict[str, np.ndarray], repeats: int = 5,
             ops, hints or "auto", no_deletes)
     except Exception as e:  # pragma: no cover - disclosure over failure
         out["chain_audit"] = {"error": repr(e)[:200]}
+    # ops-axis sharded-trace audit (ISSUE 13): per-shard width vs the
+    # ceil(M/k)+halo budget, collective bytes, and which crowding leg
+    # compiled — same never-fatal policy as the chain audit above
+    try:
+        from ..parallel import opsaxis
+        out["opsaxis"] = opsaxis.audit_opsaxis(
+            ops, hints=hints or "auto")
+    except Exception as e:  # pragma: no cover - disclosure over failure
+        out["opsaxis"] = {"error": repr(e)[:200]}
     if expected_ts is not None:
         out["order_exact"] = bool(order_ok)
     if audit:

@@ -284,13 +284,109 @@ def derive_slot_hints(arrs: dict) -> dict:
     real_parent = p_found & (p_slot >= 1) & (p_slot <= n)
     pr = first_of_rank[np.clip(p_slot - 1, 0, n)]
     parent_row = np.where(real_parent & (pr < n), pr, -1).astype(np.int32)
-    return {"parent_sl": parent_sl, "at_sl": at_sl,
-            "anchor_psl": anchor_psl, "dup_row": dup,
-            "win_row": win_row, "parent_row": parent_row}
+    out = {"parent_sl": parent_sl, "at_sl": at_sl,
+           "anchor_psl": anchor_psl, "dup_row": dup,
+           "win_row": win_row, "parent_row": parent_row}
+    crowd = derive_crowding_hints(arrs, out)
+    if crowd is not None:
+        out.update(crowd)
+    return out
 
 
 SLOT_HINT_COLS = ("parent_sl", "at_sl", "anchor_psl", "dup_row",
                   "win_row", "parent_row")
+
+# sibling-crowding pre-pass hints (ISSUE 13 satellite) — slot-space
+# columns (entry k describes slot k+1), derived + VERIFIED below;
+# capacity-dependent like the slot hints, so re-pads recompute them
+CROWD_HINT_COLS = ("crowd_slot", "crowd_cpos")
+
+
+def derive_crowding_hints(arrs: dict, slot_hints: dict):
+    """The verified sibling-crowding pre-pass (ROADMAP's
+    "verified-predicate design", scoped to the vouched all-adds case):
+    when the host can PROVE from the already-derived slot hints that
+    every canonical add is valid and every anchor is causally older
+    (anchor slot < own slot), the kernel's order forest is the
+    elementwise ``where(sentinel, pslot, aslot)`` — zero NSA trips —
+    and the crowded-sibling structure is computable here with one
+    ``bincount``.  The emitted columns let merge._finish skip the
+    scatter-add + gather + cumsum trio STATICALLY:
+
+    - ``crowd_slot`` i8[N]: 1 iff slot k+1 is a crowded-parent child
+      (its order parent has ≥ 2 children) — the kernel's ``crowded``.
+    - ``crowd_cpos`` i32[N]: inclusive crowded-count prefix minus one
+      over slots 1..N — the kernel's ``cpos`` (ROOT/NULL never crowd).
+
+    Returns ``None`` whenever ANY condition fails to verify — deletes
+    present, an unresolved/invalid row, a non-causal anchor — so a
+    batch the host cannot vouch keeps the device-side counting leg
+    (utils/chainaudit records which leg a trace runs).  This is
+    verification, not trust: every property checked here is exactly
+    the property the kernel's validity stages would derive, so the
+    emitted columns equal the device-computed ones bit for bit (pinned
+    across the sweep shapes by tests/test_merge_kernel.py and
+    tests/test_opsaxis.py)."""
+    kind = arrs["kind"]
+    ts = arrs["ts"]
+    n = int(kind.shape[0])
+    if n == 0 or np.any(kind == KIND_DELETE) or \
+            "depth" not in arrs or "paths" not in arrs:
+        return None
+    win_row = slot_hints["win_row"]
+    used = win_row < n
+    rows = win_row[used]
+    crowd_slot = np.zeros(n, np.int8)
+    if rows.size:
+        pf = slot_hints["parent_sl"][rows]
+        af = slot_hints["at_sl"][rows]
+        psl, pfd = pf >> 1, (pf & 1).astype(bool)
+        asl, afd = af >> 1, (af & 1).astype(bool)
+        slots = (np.nonzero(used)[0] + 1).astype(np.int64)
+        anchor_sent = arrs["anchor_ts"][rows] == 0
+        # causal anchors: 0 NSA trips ⇔ every non-sentinel anchor
+        # resolved to a strictly smaller slot
+        if not np.all(anchor_sent | (afd & (asl >= 1) & (asl < slots))):
+            return None
+        if not (np.all(pfd) and np.all(ts[rows] > 0)):
+            return None
+        d = arrs["depth"][rows].astype(np.int64)
+        paths = arrs["paths"]
+        D = int(paths.shape[1])
+        root_par = psl == 0
+        par_row = np.where(root_par, 0,
+                           win_row[np.clip(psl - 1, 0, n - 1)])
+        if not np.all(root_par | (par_row < n)):
+            return None
+        pd = np.where(root_par, 0, arrs["depth"][par_row])
+        if not np.all((d >= 1) & (d <= D) & (d == pd + 1)):
+            return None
+        # claimed prefix == parent's materialised path (the kernel's
+        # exact-equality check, vectorized): parent materialised =
+        # parent claimed with its own ts at depth-1
+        if D > 1 or np.any(d > 1):
+            cols = np.arange(D, dtype=np.int64)[None, :]
+            pp = np.where(root_par[:, None], 0, paths[par_row])
+            pts = np.where(root_par, 0, ts[par_row])
+            par_mat = np.where(cols == (pd - 1)[:, None],
+                               pts[:, None], pp)
+            if not np.all(np.where(cols < (d - 1)[:, None],
+                                   paths[rows] == par_mat, True)):
+                return None
+        # anchor is a sibling: the anchor row's own parent resolution
+        # must equal ours (the kernel's elementwise ``ansl`` check)
+        a_par = slot_hints["anchor_psl"][rows] >> 1
+        if not np.all(anchor_sent |
+                      (afd & (a_par == psl) & (asl != 0))):
+            return None
+        # every canonical add verified valid: the order forest is
+        # elementwise and crowding is one bincount
+        star = np.where(anchor_sent, psl, asl).astype(np.int64)
+        cnt = np.bincount(star, minlength=n + 2)
+        crowd_slot[slots - 1] = (cnt[star] >= 2).astype(np.int8)
+    crowd_cpos = (np.cumsum(crowd_slot, dtype=np.int64) - 1) \
+        .astype(np.int32)
+    return {"crowd_slot": crowd_slot, "crowd_cpos": crowd_cpos}
 
 
 def verify_hints(p: PackedOps, check_rank: bool = True) -> bool:
@@ -365,7 +461,7 @@ def pad_arrays(ops: dict, n: int) -> dict:
     had_slot_hints = any(k in ops for k in SLOT_HINT_COLS)
     out = {}
     for k, v in ops.items():
-        if k in SLOT_HINT_COLS:
+        if k in SLOT_HINT_COLS or k in CROWD_HINT_COLS:
             continue
         pad_width = [(0, n - cur)] + [(0, 0)] * (v.ndim - 1)
         if k == "kind":

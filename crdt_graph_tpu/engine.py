@@ -67,6 +67,20 @@ def matz_enabled() -> bool:
     return os.environ.get("GRAFT_MATZ", "1").strip() != "0"
 
 
+def _routed_materialize(arrays, hints):
+    """The single-merge kernel dispatch for every engine-driven
+    materialization: the stock single-device kernel, or the ops-axis
+    sharded path (parallel/opsaxis.py) when the GRAFT_OPSAXIS route is
+    enabled and the candidate set is at or past
+    GRAFT_OPSAXIS_MIN_OPS on a multi-device host.  The sharded table
+    is bit-identical (shapes included — divisibility is part of the
+    route gate), so chunked-apply rollback, ``last_applied_mask``
+    attribution, fingerprints, and sync windows ride through
+    unchanged (pinned by tests/test_opsaxis.py)."""
+    from .parallel import opsaxis
+    return opsaxis.routed_materialize(arrays, hints)
+
+
 def _mode(p: PackedOps) -> Optional[str]:
     """Kernel hint mode for a packed batch: the cond-free "exhaustive"
     path when this engine's own ingest vouched for hint completeness
@@ -106,10 +120,11 @@ def _mode(p: PackedOps) -> Optional[str]:
         if p.slot_hints is not None:
             fresh = packed_mod.derive_slot_hints(
                 {k: getattr(p, k) for k in
-                 ("kind", "ts", "parent_ts", "anchor_ts", "parent_pos",
-                  "anchor_pos", "target_pos", "ts_rank")})
+                 ("kind", "ts", "parent_ts", "anchor_ts", "depth",
+                  "paths", "parent_pos", "anchor_pos", "target_pos",
+                  "ts_rank")})
             import numpy as _np
-            if any(not _np.array_equal(p.slot_hints[k], fresh[k])
+            if any(not _np.array_equal(p.slot_hints.get(k), fresh[k])
                    for k in fresh):
                 raise RuntimeError(
                     "cached slot-hint columns diverge from the audited "
@@ -405,8 +420,8 @@ class TpuTree:
         then caches."""
         if self._table is None:
             p = self._ensure_packed()
-            self._table = merge_mod.materialize(p.arrays(),
-                                                hints=_mode(p))
+            self._table = _routed_materialize(p.arrays(),
+                                              hints=_mode(p))
         if not isinstance(self._table.status, np.ndarray):
             self._table = view_mod.to_host(self._table)
         return self._table
@@ -664,7 +679,7 @@ class TpuTree:
         p = self.prepare_packed(pnew)
         # device table; only the status column reads back here (table()
         # converts the rest lazily, off the serving path)
-        table = merge_mod.materialize(p.arrays(), hints=_mode(p))
+        table = _routed_materialize(p.arrays(), hints=_mode(p))
         return self.finish_packed(pnew, p, table)
 
     def packed_route(self, n: int) -> bool:
@@ -804,7 +819,7 @@ class TpuTree:
         p = packed_mod.concat(self._ensure_packed(),
                               packed_mod.pack(leaves,
                                               max_depth=self._max_depth))
-        table = merge_mod.materialize(p.arrays(), hints=_mode(p))
+        table = _routed_materialize(p.arrays(), hints=_mode(p))
         n0 = len(self._log)
         st = np.asarray(table.status)[n0:n0 + len(leaves)]
         failing = np.nonzero((st == NOT_FOUND) | (st == INVALID_PATH))[0]

@@ -389,6 +389,27 @@ def render_engine(engine) -> str:
         w.gauge("crdt_sched_pipeline_inflight",
                 "Fsync jobs queued or executing on the sync worker",
                 ps["inflight"])
+    # -- ops-axis sharded merge routing (parallel/opsaxis.py; ISSUE 13) ---
+    from ..parallel import opsaxis as opsaxis_mod
+    ax = opsaxis_mod.stats()
+    w.gauge("crdt_opsaxis_enabled",
+            "1 when GRAFT_OPSAXIS routing is armed on this host",
+            1.0 if ax["enabled"] else 0.0)
+    w.gauge("crdt_opsaxis_devices",
+            "Ops-axis mesh width (largest pow2 <= local devices)",
+            ax["devices"] or opsaxis_mod.mesh_devices())
+    w.gauge("crdt_opsaxis_min_ops",
+            "Sharded-route threshold (GRAFT_OPSAXIS_MIN_OPS)",
+            ax["min_ops"])
+    w.gauge("crdt_opsaxis_halo_rows",
+            "Static halo rows per shard edge of the windowed plane "
+            "sweeps", ax["halo_rows"])
+    w.counter("crdt_opsaxis_merges_total",
+              "Merges routed to the ops-axis sharded kernel",
+              ax["merges"])
+    w.counter("crdt_opsaxis_routed_ops_total",
+              "Candidate-set rows merged through the sharded kernel",
+              ax["routed_ops"])
     maint = getattr(engine, "maintenance", None)
     if maint is not None:
         ms = maint.stats()

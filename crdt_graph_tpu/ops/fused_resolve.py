@@ -111,6 +111,22 @@ HOP_J = 1152       # 2nd-hop locality bound, rounded to a lane tile
 SPAN2 = SPAN + 2 * HOP_J   # 2nd-hop window rows per tile
 
 
+def halo_window_ok(idx: jax.Array, w: int, halo: int,
+                   nrows: int) -> jax.Array:
+    """The ops-axis halo twin of this module's per-tile span checks
+    (parallel/opsaxis.py): output row j belongs to shard j // w, whose
+    plane window is ``[shard_lo - halo, shard_lo + w + halo)``; rows 0
+    and nrows-1 (ROOT/NULL frames) are overlaid elementwise by the
+    windowed gather and therefore exempt.  Replicated scalar — every
+    device evaluates the same predicate, so the ``lax.cond`` fallback
+    to the single-device gather stays uniform across the mesh."""
+    own_lo = (jnp.arange(idx.shape[0], dtype=jnp.int32) //
+              jnp.int32(w)) * jnp.int32(w)
+    exempt = (idx <= 0) | (idx >= nrows - 1)
+    in_win = (idx >= own_lo - halo) & (idx < own_lo + w + halo)
+    return jnp.all(exempt | in_win)
+
+
 if HAVE_PALLAS:
     def _kernel2(starts_ref, idx_ref, plane_hbm, out_ref, out2_ref,
                  scr_a, scr_b, sem_a, sem_b, *, hop_col, r_rows):

@@ -541,12 +541,36 @@ def _probe_sum(*arrs):
     return fingerprint(arrs)
 
 
+def crowding_hinted(ops, hints, no_deletes: bool) -> bool:
+    """Trace-time predicate for the sibling-crowding static skip: the
+    host derived (and VERIFIED — codec/packed.derive_crowding_hints)
+    the crowding structure, so the scatter-add + gather + cumsum trio
+    drops out of the trace.  Mirrors ``_finish``'s gate exactly (the
+    fused slot-hint resolution + the crowd columns) so utils/chainaudit
+    can record which leg a batch's trace runs."""
+    have_link = all(k in ops for k in ("parent_pos", "anchor_pos",
+                                       "target_pos"))
+    have_slot = hints == "exhaustive" and have_link and \
+        "ts_rank" in ops and all(
+            k in ops for k in ("parent_sl", "at_sl", "anchor_psl",
+                               "dup_row"))
+    # the trio only exists on the compacted sibling branch (S_CAP < M,
+    # _finish); below that width both legs compile the same trace and
+    # no leg is "hinted"
+    n = ops["kind"].shape[0] if "kind" in ops else 0
+    compacted = _env_cap("GRAFT_S_CAP", S_CAP_DEFAULT) < n + 2
+    return (have_slot and no_deletes and compacted and
+            "crowd_slot" in ops and "crowd_cpos" in ops and
+            _fused_flag("GRAFT_CROWD_HINTS"))
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _materialize(ops: Dict[str, jax.Array],
                  use_pallas: Optional[bool] = None,
                  hints: Optional[str] = None,
                  no_deletes: bool = False,
-                 probe: Optional[int] = None) -> NodeTable:
+                 probe: Optional[int] = None,
+                 part=None) -> NodeTable:
     """``use_pallas``: pallas usage for the rank-expansion gathers
     (ops/mono_gather.py).  None = auto (Mosaic kernel on TPU backends,
     lax elsewhere); wrappers whose transforms the pallas call must not
@@ -583,7 +607,16 @@ def _materialize(ops: Dict[str, jax.Array],
     sort+tour | 6 run contraction+Wyllie+expansion | 7 ranks+orders |
     None full kernel.  Stage-5 SUB-cuts for adversarial attribution
     (between 4 and 5, in code order): 41 NSA chase | 42 + lifting cond |
-    43 + sibling links."""
+    43 + sibling links.
+
+    ``part``: optional ops-axis partition context
+    (parallel/opsaxis.OpsAxisPart).  When set, the trace is being built
+    INSIDE a shard_map body and the billed M-wide memory ops route
+    through the context's sharded implementations (halo-windowed plane
+    gathers, all-reduce-joined frame scatters, ring-carry chunked
+    scans) — ceil(M/k) width per device, bit-identical results.  Only
+    reachable via ``_materialize.__wrapped__`` (a Python object cannot
+    cross the jit signature)."""
     kind = ops["kind"]
     ts = ops["ts"].astype(jnp.int64)
     parent_ts = ops["parent_ts"].astype(jnp.int64)
@@ -696,6 +729,17 @@ def _materialize(ops: Dict[str, jax.Array],
     have_slot = hints == "exhaustive" and have_rank and all(
         k in ops for k in ("parent_sl", "at_sl", "anchor_psl", "dup_row"))
 
+    def _win_frame(has_rank_arr, op_slot_arr):
+        """The winner scatter-min (min array row per slot), part-routed
+        when partitioned (per-device index width N/k + psum-style
+        pmin join, parallel/opsaxis.py)."""
+        row_idx = jnp.arange(N, dtype=jnp.int32)
+        tgt = jnp.where(has_rank_arr, op_slot_arr, M)
+        if part is not None:
+            return part.frame_reduce(M, IPOS, tgt, row_idx, "min")
+        return jnp.full(M, IPOS, jnp.int32).at[tgt].min(row_idx,
+                                                        mode="drop")
+
     if have_slot:
         rank = ops["ts_rank"].astype(jnp.int32)
         is_real_add = is_add & (ts > 0) & (ts < BIG)
@@ -710,10 +754,7 @@ def _materialize(ops: Dict[str, jax.Array],
             win = jnp.concatenate(
                 [pad, ops["win_row"].astype(jnp.int32), pad])
         else:
-            row_idx = jnp.arange(N, dtype=jnp.int32)
-            win = jnp.full(M, IPOS, jnp.int32).at[
-                jnp.where(has_rank, op_slot_r, M)].min(row_idx,
-                                                       mode="drop")
+            win = _win_frame(has_rank, op_slot_r)
         op_is_dup_r = ops["dup_row"].astype(bool) & has_rank
         is_node_slot_r = win < jnp.int32(N)
         pf = ops["parent_sl"].astype(jnp.int32)
@@ -735,9 +776,10 @@ def _materialize(ops: Dict[str, jax.Array],
         # independent of the pos column, so a producer violating the
         # pos == array-index contract cannot make the two paths disagree
         row_idx = jnp.arange(N, dtype=jnp.int32)
-        win = jnp.full(M, IPOS, jnp.int32).at[
-            jnp.where(has_rank, op_slot_r, M)].min(row_idx, mode="drop")
-        is_canon_op = has_rank & (row_idx == win[op_slot_r])
+        win = _win_frame(has_rank, op_slot_r)
+        win_back = part.gather_rows(win, op_slot_r) if part is not None \
+            else win[op_slot_r]
+        is_canon_op = has_rank & (row_idx == win_back)
         op_is_dup_r = has_rank & ~is_canon_op
         # Node columns by GATHER through the winner row — the scatter-min
         # above is the ONE scatter this construction keeps (the former
@@ -798,12 +840,12 @@ def _materialize(ops: Dict[str, jax.Array],
     if probe == 1:
         return acc
     return _finish(ops, sel, use_pallas, no_deletes, probe=probe,
-                   acc=acc)
+                   acc=acc, part=part)
 
 
 def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             no_deletes: bool, probe: Optional[int] = None,
-            acc=None) -> NodeTable:
+            acc=None, part=None) -> NodeTable:
     """Stages 3-13: node-table construction through per-op statuses,
     from the resolution interface (the 10-tuple ``sel``).  Extracted
     from ``_materialize`` so the explicitly partitioned resolve
@@ -900,12 +942,24 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             [dsv_src[:, None], pa[:, None]] + extra + [paths], axis=1)
         if fused2:
             g, g2 = _plane_rows2(plane, nsr, HOP_COL, use_pallas)
+        elif part is not None:
+            # ops-axis sharded: each device sweeps only its own slot
+            # range's rows through a halo window (span violation falls
+            # back to this very lax gather — parallel/opsaxis.py)
+            g = part.plane_rows(plane, nsr)
         else:
             g = _plane_rows(plane, nsr, use_pallas)
         k = 2 + len(extra)
         dsv, pa_g, claimed_raw = g[:, 0], g[:, 1], g[:, k:]
         if fused:
             ap_g, ts_g = g[:, 2], g[:, 3]
+    elif part is not None:
+        dsv = part.gather_rows(dsv_src, nsr)
+        pa_g = part.gather_rows(pa, nsr)
+        claimed_raw = part.gather_rows(paths, nsr)
+        if fused:
+            ap_g = part.gather_rows(ap_src, nsr)
+            ts_g = part.gather_rows(ts, nsr)
     else:
         dsv = dsv_src[nsr]
         pa_g = pa[nsr]
@@ -982,11 +1036,17 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         # gather through pslot; the fp repack below (the kernel's output
         # plane, line ~1229) is the same _pack_u expression, so XLA CSEs
         # it — the pack itself costs nothing extra
-        pplane = jnp.concatenate(
+        pplane_src = jnp.concatenate(
             [_pack_u(fp_h, fp_l), node_depth[:, None].astype(jnp.int64)],
-            axis=1)[pslot]
+            axis=1)
+        pplane = part.plane_rows(pplane_src, pslot) \
+            if part is not None else pplane_src[pslot]
         par_h, par_l = _split_u(pplane[:, :D])
         par_depth = pplane[:, D].astype(jnp.int32)
+    elif part is not None:
+        par_h = part.gather_rows(fp_h, pslot)
+        par_l = part.gather_rows(fp_l, pslot)
+        par_depth = part.gather_rows(node_depth, pslot)
     else:
         par_h, par_l = fp_h[pslot], fp_l[pslot]
         par_depth = node_depth[pslot]
@@ -1002,6 +1062,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         # gather (``ansl``): the sibling check is elementwise instead of
         # one more M-wide gather through aslot
         anchor_parent = ansl >> 1
+    elif part is not None:
+        anchor_parent = part.gather_rows(pslot, aslot)
     else:
         anchor_parent = pslot[aslot]
     anchor_ok = node_anchor_is_sentinel | \
@@ -1073,26 +1135,37 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         anc_del = jnp.full(M, IPOS, jnp.int32)
         dead = jnp.zeros(M, bool)
     else:
+        _rows = part.gather_rows if part is not None \
+            else (lambda t, i: t[i])
         d_depth_ok = (depth >= 1) & (depth <= D) & \
-            (node_depth[d_tslot] == depth)
+            (_rows(node_depth, d_tslot) == depth)
         paths_h, paths_l = _split_u(paths)   # per-op plane, elementwise
         d_path_ok = jnp.all(
             jnp.where(cols < depth[:, None],
-                      (paths_h == fp_h[d_tslot]) &
-                      (paths_l == fp_l[d_tslot]), True),
+                      (paths_h == _rows(fp_h, d_tslot)) &
+                      (paths_l == _rows(fp_l, d_tslot)), True),
             axis=1)
-        d_ok = is_del & d_tfound & (d_tslot != ROOT) & valid[d_tslot] & \
-            d_depth_ok & d_path_ok
+        d_ok = is_del & d_tfound & (d_tslot != ROOT) & \
+            _rows(valid, d_tslot) & d_depth_ok & d_path_ok
         d_tgt = jnp.where(d_ok, d_tslot, NULL)
-        deleted = jnp.zeros(M, bool).at[d_tgt].set(True).at[NULL].set(False)
-        del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
-            .at[NULL].set(IPOS)
+        if part is not None:
+            deleted = part.frame_reduce(
+                M, 0, d_tgt, jnp.ones(N, jnp.int32), "max"
+            ).astype(bool).at[NULL].set(False)
+            del_pos = part.frame_reduce(M, IPOS, d_tgt, pos, "min") \
+                .at[NULL].set(IPOS)
+        else:
+            deleted = jnp.zeros(M, bool).at[d_tgt].set(True) \
+                .at[NULL].set(False)
+            del_pos = jnp.full(M, IPOS, jnp.int32).at[d_tgt].min(pos) \
+                .at[NULL].set(IPOS)
 
         # ---- 8. Dead-subtree propagation down tree-parent chains (delete
         # discards descendants, Internal/Node.elm:237-238).  Also carries
         # the earliest ancestor-delete position for absorption statuses.
         # Skipped when the batch has no effective delete.
-        anc_del = jnp.where(deleted[parent_eff], del_pos[parent_eff], IPOS)
+        anc_del = jnp.where(_rows(deleted, parent_eff),
+                            _rows(del_pos, parent_eff), IPOS)
         anc_del = _fix_min(anc_del, parent_eff, jnp.any(d_ok),
                            _ceil_log2(D) + 1)
         dead = valid & (anc_del < IPOS)
@@ -1211,14 +1284,40 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     skey = jnp.where(in_forest, order_parent, NULL).astype(jnp.int32)
     neg_slot = jnp.where(in_forest, -slot_ids, IPOS)
     S_CAP = _env_cap("GRAFT_S_CAP", S_CAP_DEFAULT)
+    # sibling-crowding pre-pass hint (ISSUE 13 satellite): for vouched
+    # all-adds batches whose crowding structure the host derived AND
+    # verified (codec/packed.derive_crowding_hints — all rows valid,
+    # every anchor causally older), the crowded flags and their
+    # compaction positions arrive as slot-space columns and the
+    # scatter-add + gather + cumsum trio drops out of the trace
+    # STATICALLY.  Gate mirrored by merge.crowding_hinted so the chain
+    # auditor records which leg a trace runs.
+    crowd_hinted = fused and no_deletes and \
+        "crowd_slot" in ops and "crowd_cpos" in ops and \
+        _fused_flag("GRAFT_CROWD_HINTS")
     if S_CAP >= M:
         sib_next, first_child = _sib_links(skey, ggrp, neg_slot)
     else:
         par = jnp.where(in_forest, order_parent, M)
-        cnt = jnp.zeros(M, jnp.int32).at[par].add(1, mode="drop")
-        crowded = in_forest & (cnt[jnp.minimum(par, M - 1)] >= 2)
-        cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
-        n_crowded = cpos[M - 1] + 1
+        if crowd_hinted:
+            pad_f = jnp.zeros(1, bool)
+            crowded = jnp.concatenate(
+                [pad_f, ops["crowd_slot"].astype(bool), pad_f])
+            cc = ops["crowd_cpos"].astype(jnp.int32)
+            cpos = jnp.concatenate(
+                [jnp.full(1, -1, jnp.int32), cc, cc[N - 1:N]])
+            n_crowded = cc[N - 1] + 1
+        else:
+            if part is not None:
+                cnt = part.frame_add(M, par)
+                crowded = in_forest & (part.gather_rows(
+                    cnt, jnp.minimum(par, M - 1)) >= 2)
+                cpos = part.cumsum(crowded.astype(jnp.int32)) - 1
+            else:
+                cnt = jnp.zeros(M, jnp.int32).at[par].add(1, mode="drop")
+                crowded = in_forest & (cnt[jnp.minimum(par, M - 1)] >= 2)
+                cpos = lax.cumsum(crowded.astype(jnp.int32)) - 1
+            n_crowded = cpos[M - 1] + 1
 
         def _br_compact(cap):
             """The compact sibling branch at static width ``cap``: the
@@ -1287,13 +1386,22 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
                             neg_slot, mode="drop", unique_indices=True)
                 sib, fc = _sib_links(kp, gg, neg)
                 # singleton children: the parent's whole child list
-                single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
-                fc = fc.at[jnp.where(in_forest & ~crowded,
-                                     order_parent, M)
-                           ].set(jnp.where(single_v < M, single_v, -1),
-                                 mode="drop", unique_indices=True)
-                return sib, fc
+                return sib, _fc_singletons(fc)
             return br
+
+        def _fc_singletons(fc):
+            """The singleton first-child overlay (every uncrowded
+            parent's one child), part-routed when partitioned: each
+            device scatters its ceil(M/k) pairs into a -1 frame and a
+            pmax joins (targets unique — a parent is crowded xor
+            singleton; values are slots ≥ 1)."""
+            tgt = jnp.where(in_forest & ~crowded, order_parent, M)
+            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
+            val = jnp.where(single_v < M, single_v, -1)
+            if part is not None:
+                ov = part.frame_set(M, -1, tgt, val, "max")
+                return jnp.where(ov >= 0, ov, fc)
+            return fc.at[tgt].set(val, mode="drop", unique_indices=True)
 
         def br_single(_):
             """ALL crowded rows share one (parent, group) key — the flat
@@ -1304,7 +1412,9 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             slot's sib_next is the previous crowded slot (one running
             max), first_child of the one key is the largest crowded
             slot (a reduce)."""
-            pc = lax.cummax(jnp.where(crowded, slot_ids, -1))
+            pc_src = jnp.where(crowded, slot_ids, -1)
+            pc = part.cummax(pc_src) if part is not None \
+                else lax.cummax(pc_src)
             prev = jnp.concatenate(
                 [jnp.full(1, -1, jnp.int32), pc[:-1]])
             sib = jnp.where(crowded, prev, -1)
@@ -1312,11 +1422,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
             gkey = jnp.clip(jnp.max(jnp.where(crowded, skey, -1)),
                             0, M - 1)
             fc = jnp.full(M, -1, jnp.int32).at[gkey].set(head)
-            single_v = jnp.where(in_forest & ~crowded, slot_ids, M)
-            fc = fc.at[jnp.where(in_forest & ~crowded, order_parent, M)
-                       ].set(jnp.where(single_v < M, single_v, -1),
-                             mode="drop", unique_indices=True)
-            return sib, fc
+            return sib, _fc_singletons(fc)
 
         ckey = jnp.where(crowded, skey, IPOS)
         cgrp = jnp.where(crowded, ggrp.astype(jnp.int32), IPOS)
@@ -1429,9 +1535,16 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         [exists.astype(jnp.int32)] if single_w else
         [exists.astype(jnp.int32), visible.astype(jnp.int32)])
     from . import tour_scan
-    rid_incl, w_incl = tour_scan.prefix_sums(
-        boundary.astype(jnp.int32), w_lanes,
-        use_pallas if _fused_flag("GRAFT_FUSED_SCAN") else False)
+    if part is not None:
+        # ops-axis sharded: local ceil(M/k)-chunk scans + one fused
+        # ring exchange of run-id/suffix-weight carries + local fixup
+        # (ops/tour_scan.sharded_prefix_sums; exact by associativity)
+        rid_incl, w_incl = part.prefix_sums(
+            boundary.astype(jnp.int32), w_lanes)
+    else:
+        rid_incl, w_incl = tour_scan.prefix_sums(
+            boundary.astype(jnp.int32), w_lanes,
+            use_pallas if _fused_flag("GRAFT_FUSED_SCAN") else False)
     rid = rid_incl - 1                   # run id per token
     z1 = jnp.zeros(1, jnp.int32)
     cse_doc = jnp.concatenate([z1, w_incl[0]])
@@ -1516,6 +1629,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         ] + ([] if single_w else [
             cse_vis[run_s_c[:out]], cse_vis[run_e1_c[:out]], a_vis[:out],
         ]))
+        if part is not None:
+            return part.mono_expand(per_run, rid[:M])
         return mono_gather.monotone_gather(per_run, rid[:M],
                                            use_pallas=use_pallas)
 
@@ -1595,9 +1710,18 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     doc_dense = rank_of(ex[1], ex[2], ex[3], cse_doc)
 
     doc_index = jnp.where(exists, doc_dense, IPOS)
-    order = jnp.full(M, NULL, jnp.int32).at[
-        jnp.where(exists, doc_dense, M)].set(
+
+    def _order_frame(mask, dense):
+        """Rank→slot frame scatter, part-routed when partitioned (ranks
+        are globally unique and slots < NULL, so per-device scatters
+        join exactly under pmin)."""
+        tgt = jnp.where(mask, dense, M)
+        if part is not None:
+            return part.frame_set(M, NULL, tgt, slot_ids, "min")
+        return jnp.full(M, NULL, jnp.int32).at[tgt].set(
             slot_ids, mode="drop", unique_indices=True)
+
+    order = _order_frame(exists, doc_dense)
     if single_w:
         # no deletes ⇒ visible ≡ exists ⇒ the visible order IS the
         # document order, statically — the second rank expansion and
@@ -1605,9 +1729,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         visible_order = order
     else:
         vis_dense = rank_of(ex[4], ex[5], ex[6], cse_vis)
-        visible_order = jnp.full(M, NULL, jnp.int32).at[
-            jnp.where(visible, vis_dense, M)].set(
-                slot_ids, mode="drop", unique_indices=True)
+        visible_order = _order_frame(visible, vis_dense)
     if probe is not None:
         acc = acc + _probe_sum(doc_index, order, visible_order)
         if probe == 7:
@@ -1618,6 +1740,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # five separate ones.
     status = jnp.full(N, PAD, jnp.int8)
     a_slot = op_slot
+    _prow = part.gather_rows if part is not None \
+        else (lambda t, i: t[i])
     # an Add with ts 0 collides with the branch-head sentinel: the reference
     # finds an existing child and reports AlreadyApplied
     a_sentinel = ts <= 0
@@ -1629,7 +1753,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         # the one per-op meta gather below
         meta_s = valid.astype(jnp.int32) | \
             (parent_ok.astype(jnp.int32) << 1)
-        a_meta = meta_s[a_slot]
+        a_meta = _prow(meta_s, a_slot)
         a_valid = (a_meta & 1) != 0
         a_parent_ok = (a_meta & 2) != 0
 
@@ -1655,8 +1779,8 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     else:
         meta = (valid.astype(jnp.int32)
                 | (parent_ok.astype(jnp.int32) << 1)
-                | (valid[pslot].astype(jnp.int32) << 2))
-        a_meta = meta[a_slot]
+                | (_prow(valid, pslot).astype(jnp.int32) << 2))
+        a_meta = _prow(meta, a_slot)
         a_valid = (a_meta & 1) != 0
         a_parent_ok = (a_meta & 2) != 0
         a_grandvalid = (a_meta & 4) != 0     # valid[pslot[a_slot]]
@@ -1664,7 +1788,7 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
         # the anc_del frame is a constant there, so the gather would be
         # a dead M-wide op the chain budget still counts at trace level
         a_absorbed = False if no_deletes else \
-            a_valid & (anc_del[a_slot] < pos)
+            a_valid & (_prow(anc_del, a_slot) < pos)
         a_status = jnp.where(
             a_sentinel | (a_valid & (op_is_dup | a_absorbed)),
             ALREADY_APPLIED,
@@ -1675,10 +1799,10 @@ def _finish(ops: Dict[str, jax.Array], sel, use_pallas: Optional[bool],
     # deletes (statically absent under the no-deletes promise)
     if not no_deletes:
         d_parent_ok = (depth == 1) | \
-            ((depth >= 2) & dp_found & ((meta[dp_slot] & 1) != 0))
-        d_anc_absorbed = d_ok & (anc_del[d_tslot] < pos)
-        d_repeat = d_ok & (del_pos[d_tslot] < pos)
-        d_target_later = d_ok & (node_pos[d_tslot] > pos)
+            ((depth >= 2) & dp_found & ((_prow(meta, dp_slot) & 1) != 0))
+        d_anc_absorbed = d_ok & (_prow(anc_del, d_tslot) < pos)
+        d_repeat = d_ok & (_prow(del_pos, d_tslot) < pos)
+        d_target_later = d_ok & (_prow(node_pos, d_tslot) > pos)
         # deleting a branch-head sentinel (ts 0) finds a tombstone:
         # AlreadyApplied
         d_sentinel = (ts == 0) & d_parent_ok

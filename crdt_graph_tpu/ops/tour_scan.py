@@ -115,6 +115,159 @@ def _pad_tile(x: jax.Array) -> jax.Array:
     return jnp.pad(x, (0, -n % TILE))
 
 
+# ---- ops-axis sharded formulation (ISSUE 13) ---------------------------
+#
+# The same prefix sums with the token/slot axes SHARDED over a 1-D mesh
+# axis: each device scans only its contiguous chunk (width ceil(M/k) —
+# the per-shard width utils/chainaudit.py v3 bills), the per-chunk
+# totals ride ONE fused ring exchange (lax.ppermute Hillis-Steele over
+# the device axis — the "run-id offset + suffix-weight carry" exchange
+# docs/SHARD_TAIL.md §4 items 1-2 designed), and a local elementwise
+# fixup adds each chunk's exclusive carry.  Integer addition is
+# associative and exact, so the sharded result is bit-identical to the
+# single-device cumsum by construction.
+#
+# The T = 2M token axis splits as TWO ceil(M/k)-chunks per device (its
+# enter-half chunk and its exit-half chunk) rather than one 2M/k chunk,
+# so no billed op inside the shard body exceeds the M/k + halo budget.
+
+
+def ring_exclusive(vals: jax.Array, axis: str, k: int,
+                   op: str = "add") -> jax.Array:
+    """Exclusive prefix of per-shard carry vectors around the mesh ring.
+
+    ``vals`` is each device's [L]-lane local total; returns the [L]
+    combine of all LOWER-indexed devices' totals (device 0: the
+    identity).  log2(k)+1 ``lax.ppermute`` hops (Hillis-Steele
+    inclusive, then one shift) — the carries are a handful of scalars,
+    so latency, not bytes, prices this.  ``op="add"`` assumes identity
+    0 (ppermute delivers zeros to devices with no sender); ``op="max"``
+    requires the caller to BIAS values ≥ 1 so the zero-fill acts as the
+    identity there too."""
+    combine = jnp.maximum if op == "max" else (lambda a, b: a + b)
+    incl = vals
+    d = 1
+    while d < k:
+        shifted = lax.ppermute(incl, axis,
+                               [(j, j + d) for j in range(k - d)])
+        incl = combine(incl, shifted)
+        d *= 2
+    return lax.ppermute(incl, axis, [(j, j + 1) for j in range(k - 1)])
+
+
+def sharded_prefix_sums(boundary: jax.Array, weights: jax.Array, *,
+                        axis: str, k: int
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """:func:`prefix_sums` semantics with every scan chunked to
+    ceil(M/k) width per device and the carries ring-exchanged (module
+    comment above).  Must run inside ``shard_map`` over ``axis`` with
+    every operand REPLICATED; outputs are replicated (each device
+    scans its own chunks, then one tiled all-gather reassembles)."""
+    t = boundary.shape[0]
+    kw, m = weights.shape
+    w = -(-m // k)                      # chunk width = ceil(M/k)
+    i = lax.axis_index(axis)
+    b32 = boundary.astype(jnp.int32)
+    w32 = weights.astype(jnp.int32)
+    # token axis re-laid as [2, kW]: enter half then exit half, each
+    # zero-padded to kW (zeros are cumsum identities, so padding between
+    # the halves cannot change any real token's prefix)
+    t_lo = min(m, t)
+    ent = jnp.pad(b32[:t_lo], (0, k * w - t_lo))
+    ext = jnp.pad(b32[t_lo:], (0, 2 * k * w - t))
+    wp = jnp.pad(w32, ((0, 0), (0, k * w - m)))
+    # local chunks: one dynamic_slice each (free), one W-wide cumsum each
+    ca = lax.cumsum(lax.dynamic_slice(ent, (i * w,), (w,)))
+    cb = lax.cumsum(lax.dynamic_slice(ext, (i * w,), (w,)))
+    cw = lax.cumsum(lax.dynamic_slice(
+        wp, (jnp.zeros((), i.dtype), i * w), (kw, w)), axis=1)
+    # ONE fused ring exchange for every lane's carry: [2 + Kw] totals
+    totals = jnp.concatenate([ca[-1:], cb[-1:], cw[:, -1]])
+    ex = ring_exclusive(totals, axis, k)
+    # the exit half's carry additionally folds the WHOLE enter half
+    ent_total = lax.psum(ca[-1], axis)
+    out_a = ca + ex[0]
+    out_b = cb + ex[1] + ent_total
+    out_w = cw + ex[2:][:, None]
+    # reassemble replicated outputs (tiled all-gathers; the [2, W]
+    # token pair interleaves back to chunk order elementwise)
+    ab = lax.all_gather(jnp.stack([out_a, out_b]), axis,
+                        tiled=False)                   # [k, 2, W]
+    flat = jnp.transpose(ab, (1, 0, 2)).reshape(2 * k * w)
+    ob = jnp.concatenate([flat[:t_lo], flat[k * w:k * w + (t - t_lo)]])
+    wg = lax.all_gather(out_w, axis, tiled=False)      # [k, Kw, W]
+    ow = jnp.transpose(wg, (1, 0, 2)).reshape(kw, k * w)[:, :m]
+    return ob, ow
+
+
+# ---- pallas ring-carry exchange (staged for the TPU grant) -------------
+#
+# The ``ring_exclusive`` above is lax.ppermute so the 8-device
+# host-platform CPU mesh executes it for real in tier-1.  On a real TPU
+# slice the same exchange can ride one pallas kernel using
+# ``pltpu.make_async_remote_copy`` (the SNIPPETS.md [1]/[2] ring
+# pattern): each device pushes its carry vector to its right neighbour
+# k-1 times, accumulating the exclusive prefix in VMEM — one kernel
+# launch instead of log2(k)+1 XLA collectives.  Validated in interpret
+# mode where the installed jax supports interpreting remote DMAs
+# (tests/test_opsaxis.py::test_pallas_ring_carry_interpret skips
+# otherwise); priced on chip by the staged probe in
+# scripts/tpu_next_grant.sh.
+
+if HAVE_PALLAS:
+    def _ring_carry_kernel(x_ref, o_ref, comm, send_sem, recv_sem, *,
+                           k: int, axis: str):
+        my = lax.axis_index(axis)
+        acc = jnp.zeros_like(x_ref[...])
+        comm[0] = x_ref[...]
+        for step in range(k - 1):
+            s, r = step % 2, (step + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm.at[s], dst_ref=comm.at[r],
+                send_sem=send_sem.at[s], recv_sem=recv_sem.at[r],
+                device_id=(my + 1) % k,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()
+            # the received buffer IS the next hop's send slot (the s/r
+            # alternation relays it onward); after ``step+1`` hops it
+            # holds the carry ORIGINATED by the device step+1 to our
+            # ring-left, which contributes iff that sender index is
+            # below ours (exclusive prefix, ring-ordered)
+            acc = acc + jnp.where(my >= step + 1, comm[r], 0)
+        o_ref[...] = acc
+
+    def ring_exclusive_pallas(vals: jax.Array, k: int,
+                              interpret: bool = False,
+                              axis: str = "ops") -> jax.Array:
+        """Pallas twin of :func:`ring_exclusive` (add only), for use
+        inside shard_map over ``axis``.  Lanes pad to the 128-lane
+        tile; the comm buffer double-buffers so hop N+1's send never
+        overwrites hop N's payload before it is consumed.  Validated
+        in interpret mode on the CPU mesh (the installed jax's
+        remote-DMA discharge rule executes the ring for real —
+        tests/test_opsaxis.py); priced on chip by the staged probe in
+        scripts/tpu_next_grant.sh."""
+        import functools
+        lanes = vals.shape[0]
+        pad = -lanes % 128
+        x = jnp.pad(vals.astype(jnp.int32), (0, pad)).reshape(1, -1)
+        out = pl.pallas_call(
+            functools.partial(_ring_carry_kernel, k=k, axis=axis),
+            out_shape=jax.ShapeDtypeStruct(x.shape, jnp.int32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2,) + x.shape, jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            name="opsaxis_ring_carry",
+        )(x)
+        return out.reshape(-1)[:lanes]
+
+
 def prefix_sums(boundary: jax.Array, weights: jax.Array,
                 use_pallas: bool | None = None,
                 interpret: bool = False) -> Tuple[jax.Array, jax.Array]:

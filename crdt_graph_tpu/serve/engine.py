@@ -850,6 +850,9 @@ class ServingEngine:
         }
         out["maintenance"] = None if self.maintenance is None \
             else self.maintenance.stats()
+        # ops-axis sharded-merge routing (parallel/opsaxis.py)
+        from ..parallel import opsaxis
+        out["opsaxis"] = opsaxis.stats()
         return out
 
     def render_prom(self) -> str:

@@ -58,6 +58,12 @@ _SCANS = ("cumsum", "cummax", "cumprod", "cumlogsumexp")
 _CALLS = ("pjit", "closed_call", "core_call", "remat", "remat2",
           "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
           "checkpoint")
+# cross-device collectives (v3): not billed as memory ops — their cost
+# currency is BYTES MOVED, accounted separately as summed output bytes
+# per device (``collective_bytes``) and CI-pinned for the ops-axis
+# sharded trace (parallel/opsaxis.py, tests/test_chain_audit.py)
+_COLLECTIVES_P = ("all_gather", "ppermute", "psum", "pmin", "pmax",
+                  "all_to_all", "reduce_scatter")
 
 MODELED_MS_PER_OP = 6.0   # measured: PRIMS_TPU_r05.txt while-loop row
 
@@ -91,6 +97,10 @@ class ChainAudit:
     rows: List[Tuple[str, str, int, float, str]]
     width_ref: int = 0
     compact_floor: int = 0
+    # v3: which sibling-crowding leg the trace compiled
+    # (merge.crowding_hinted — "hinted" = host pre-pass columns skipped
+    # the scatter-add+gather+cumsum trio, "counted" = device counting)
+    crowding_leg: str = ""
 
     @property
     def modeled_ms_fast(self) -> float:
@@ -103,6 +113,31 @@ class ChainAudit:
     def compact_fast(self) -> int:
         return sum(1 for _, _, _, _, note in self.rows
                    if note == "compact")
+
+    # -- v3: sharded-trace accounting (parallel/opsaxis.py) ---------------
+
+    @property
+    def shard_width(self) -> int:
+        """Widest billed memory op inside any shard_map body, fast
+        path + compacted stages (slow branches — the single-device
+        fallbacks — exempt): the per-shard width the ops-axis budget
+        gate pins at ceil(M/k) + halo."""
+        return max((w for path, _, w, _, note in self.rows
+                    if "[shard]" in path and
+                    note in ("fast", "compact", "scan-body")),
+                   default=0)
+
+    @property
+    def collective_bytes(self) -> int:
+        """Summed collective OUTPUT bytes per device on the fast path
+        (the counting rule the documented opsaxis bound uses)."""
+        return sum(w for _, _, w, _, note in self.rows
+                   if note == "collective")
+
+    @property
+    def collective_count(self) -> int:
+        return sum(1 for _, _, _, _, note in self.rows
+                   if note == "collective")
 
     @property
     def compact_risk_ms(self) -> float:
@@ -122,7 +157,7 @@ class ChainAudit:
         """The bench-facing stats record (bench.py / runner.py emit it
         in every JSON row so the perf trajectory tracks the model even
         when the round-end bench falls back to CPU)."""
-        return {
+        out = {
             "fast_path": self.fast_path,
             "static": self.static,
             "modeled_ms": self.modeled_ms_fast,
@@ -131,6 +166,9 @@ class ChainAudit:
             "ok": bool(self.fast_path <= FAST_PATH_BUDGET and
                        self.modeled_ms_fast <= MODELED_MS_CAP),
         }
+        if self.crowding_leg:
+            out["crowding_leg"] = self.crowding_leg
+        return out
 
 
 def _aval_size(v) -> int:
@@ -221,8 +259,17 @@ def _count(jaxpr, threshold: int, compact_floor: int, width_ref: int,
                           key=lambda b: counts[b][0])
             for bi, (f, s, sub_rows) in enumerate(counts):
                 for r in sub_rows:
-                    rows.append(r if bi == fast_bi else
-                                (r[0], r[1], r[2], r[3], "slow-branch"))
+                    if bi == fast_bi:
+                        rows.append(r)
+                    elif r[4] == "collective":
+                        # a collective in a not-taken branch is not
+                        # fast-path traffic, but must not masquerade
+                        # as a slow-path MEMORY op either
+                        rows.append((r[0], r[1], r[2], r[3],
+                                     "collective-slow"))
+                    else:
+                        rows.append((r[0], r[1], r[2], r[3],
+                                     "slow-branch"))
             fast += f_min
             static += s_max
         elif name == "while":
@@ -249,12 +296,31 @@ def _count(jaxpr, threshold: int, compact_floor: int, width_ref: int,
                          r[4]) for r in sub_rows)
             fast += f * length
             static += s * length
+        elif name == "shard_map":
+            # v3: descend into the per-shard program — shapes inside
+            # are the LOCAL block shapes, so billed widths here are the
+            # per-device widths the ops-axis budget gate pins
+            # (parallel/opsaxis.py; [shard] tags the rows)
+            sub = eqn.params["jaxpr"]
+            sub = getattr(sub, "jaxpr", sub)
+            f, s = _count(sub, threshold, compact_floor, width_ref,
+                          f"{here}[shard]", note, rows)
+            fast += f
+            static += s
         elif name in _CALLS or "call" in name and "pallas" not in name:
             for sub in _sub_jaxprs(eqn.params):
                 f, s = _count(sub, threshold, compact_floor,
                               width_ref, f"{here}", note, rows)
                 fast += f
                 static += s
+        elif name in _COLLECTIVES_P:
+            nbytes = sum(
+                int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                if getattr(v.aval, "shape", None) is not None else 0
+                for v in eqn.outvars)
+            rows.append((here, name, nbytes, 0.0,
+                         "collective" if note in ("", "fast")
+                         else f"collective-{note}"))
         else:
             w = _width(eqn)
             counted = (name == "gather" or name in _SCATTERS or
@@ -326,7 +392,10 @@ def audit_materialize(ops: Dict[str, np.ndarray], hints: str,
     fn = functools.partial(merge_mod._materialize.__wrapped__,
                            use_pallas=use_pallas, hints=hints,
                            no_deletes=no_deletes)
-    return count_mwide(fn, shapes, threshold=threshold)
+    audit = count_mwide(fn, shapes, threshold=threshold)
+    audit.crowding_leg = "hinted" if merge_mod.crowding_hinted(
+        ops, hints, no_deletes) else "counted"
+    return audit
 
 
 def audit_summary(ops: Dict[str, np.ndarray], hints: str,

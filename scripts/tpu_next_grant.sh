@@ -53,5 +53,47 @@ cd /root/repo
   echo "=== tpu_session 8 (config6 subcuts) $(date -u +%H:%M:%S) ==="
   timeout 1500 python scripts/tpu_session.py 8 \
     >> "$OUT/tpu_round7.jsonl" 2>> "$OUT/tpu_round7.err"
+  # === ops-axis sharded merge (ISSUE 13; docs/SHARD_TAIL.md §7) ===
+  # Only meaningful on a MULTI-CHIP slice (a 1-chip grant runs k=1,
+  # which is pinned as a no-op).  Two probes, cheap first:
+  #  a) the on-chip A/B twin of BENCH_OPSAXIS_r01_cpu.json — the first
+  #     run where the op-axis wall-clock is measured on real ICI
+  #     instead of anti-correlated on the oversubscribed CPU mesh;
+  #     the audited claim it tests: 9 billed ops at ceil(M/8) width +
+  #     ~183 MB of collectives ≈ §3's ~4× single-merge ceiling
+  #  b) the pallas make_async_remote_copy ring-carry kernel vs the XLA
+  #     ppermute chain (tour_scan.ring_exclusive_pallas) — one kernel
+  #     launch vs log2(k)+1 collectives for the [2+Kw]-scalar carries
+  echo "=== opsaxis on-chip A/B $(date -u +%H:%M:%S) ==="
+  timeout 1800 env JAX_PLATFORMS=tpu GRAFT_OPSAXIS=1 \
+    python scripts/bench_opsaxis_headline.py 1000000 3 \
+    "$OUT/BENCH_OPSAXIS_r01_tpu.json" \
+    >> "$OUT/tpu_opsaxis.jsonl" 2>> "$OUT/tpu_opsaxis.err"
+  echo "=== opsaxis pallas ring-carry probe $(date -u +%H:%M:%S) ==="
+  timeout 900 env JAX_PLATFORMS=tpu python - <<'PYEOF' \
+    >> "$OUT/tpu_opsaxis.jsonl" 2>> "$OUT/tpu_opsaxis.err"
+import json, time
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from crdt_graph_tpu.ops import tour_scan
+from crdt_graph_tpu.utils import jaxcompat
+k = len(jax.devices())
+mesh = Mesh(np.asarray(jax.devices()), ("ops",))
+vals = jnp.arange(k, dtype=jnp.int32) + 1
+legs = {}
+for name, body in (
+        ("ppermute", lambda v: tour_scan.ring_exclusive(v[None], "ops", k)[0]),
+        ("pallas_ring", lambda v: tour_scan.ring_exclusive_pallas(v[None].reshape(1), k)[0])):
+    fn = jax.jit(jaxcompat.shard_map(
+        lambda v: body(v), mesh=mesh, in_specs=(P("ops"),),
+        out_specs=P("ops"), check_vma=False))
+    out = np.asarray(fn(vals)); t = []
+    for _ in range(5):
+        t0 = time.perf_counter(); np.asarray(fn(vals))
+        t.append((time.perf_counter() - t0) * 1e3)
+    legs[name] = {"p50_ms": float(np.percentile(t, 50)),
+                  "out": out.tolist()}
+print(json.dumps({"probe": "opsaxis_ring_carry", "devices": k, **legs}))
+PYEOF
   echo "=== done $(date -u +%H:%M:%S) ==="
 } >> "$OUT/tpu_next_grant.log" 2>&1

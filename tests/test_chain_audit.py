@@ -123,6 +123,54 @@ def test_fused_kill_switches_restore_the_r6_trace(monkeypatch):
         f"{off.table()}")
 
 
+def test_opsaxis_shard_width_budget_config5_1M():
+    """ISSUE 13 CI gate: the ops-axis sharded trace at the 1M config-5
+    headline bills NO fast-path memory op wider than ceil(M/k) + HALO
+    per shard, and its collective traffic stays within the documented
+    bound — a regression that silently widens a shard (or re-adds an
+    M-wide pass inside the body) fails tier-1 the way the 9-op chain
+    budget does."""
+    from crdt_graph_tpu.parallel import opsaxis
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    arrs = workloads.chain_workload(64, 1_000_000)
+    st = opsaxis.audit_opsaxis(arrs)
+    m = 1_000_000 + 2
+    assert st["devices"] == 8
+    assert st["shard_budget"] == -(-m // 8) + opsaxis.HALO
+    assert st["shard_width"] <= st["shard_budget"], st
+    assert st["ok"]
+    assert 0 < st["collective_bytes"] <= \
+        opsaxis.COLLECTIVE_BYTES_CAP_1M, st
+    # the production config-5 batch is host-verified all-valid causal,
+    # so the crowding pre-pass leg must be the hinted one
+    assert st["leg"] == "hinted"
+
+
+def test_crowding_hints_are_load_bearing(monkeypatch):
+    """Dropping the crowd columns (or killing GRAFT_CROWD_HINTS) must
+    re-add the scatter-add + gather + cumsum trio to the lax trace —
+    pinning that the host pre-pass is what removed it — and the audit
+    summary must record which leg compiled."""
+    monkeypatch.delenv("GRAFT_PACK_GATHER", raising=False)
+    arrs = dict(workloads.chain_workload(8, 65_536))
+    assert "crowd_slot" in arrs
+    hinted = _audit(arrs)
+    assert hinted.crowding_leg == "hinted"
+    assert hinted.summary()["crowding_leg"] == "hinted"
+    stripped = {k: v for k, v in arrs.items()
+                if k not in ("crowd_slot", "crowd_cpos")}
+    counted = _audit(stripped)
+    assert counted.crowding_leg == "counted"
+    # exactly the trio returns
+    assert counted.fast_path == hinted.fast_path + 3, (
+        f"hinted\n{hinted.table()}\n\ncounted\n{counted.table()}")
+    monkeypatch.setenv("GRAFT_CROWD_HINTS", "0")
+    killed = _audit(arrs)
+    assert killed.fast_path == counted.fast_path
+    assert killed.crowding_leg == "counted"
+
+
 def test_counter_basics():
     """The counter itself: gathers/scatters/sorts/scans count at or
     above threshold; elementwise chains, reductions and slices do not;

@@ -349,11 +349,32 @@ _POST_ACK_SITES = {
 }
 
 
-@pytest.mark.parametrize("pipeline", (False, True),
-                         ids=("serial", "pipelined"))
-@pytest.mark.parametrize("shared", (False, True),
-                         ids=("perdoc", "shared"))
-@pytest.mark.parametrize("site", wal_mod.CRASH_SITES)
+def _matrix_params():
+    """The full site × {perdoc, shared} × {serial, pipelined} matrix,
+    with the ISSUE-12 pipelined expansion's duplicate coverage
+    slow-marked (ISSUE 13 tier-1 wall-time satellite): tier-1 keeps
+    every SERIAL combo (the pre-expansion coverage), one pipelined
+    representative per PIPELINE-ONLY site (the sites that exist
+    nowhere else), and one {shared}×{pipelined} representative — the
+    remaining pipelined duplicates (same site already proven serial,
+    same code path already proven perdoc) run in the slow lane."""
+    tier1_pipelined = {(s, False) for s in wal_mod.PIPELINE_ONLY_SITES}
+    tier1_pipelined.add((wal_mod.CRASH_SITES[0], True))   # shared rep
+    out = []
+    for site in wal_mod.CRASH_SITES:
+        for shared in (False, True):
+            for pipeline in (False, True):
+                marks = ()
+                if pipeline and (site, shared) not in tier1_pipelined:
+                    marks = (pytest.mark.slow,)
+                out.append(pytest.param(
+                    site, shared, pipeline, marks=marks,
+                    id=f"{site}-{'shared' if shared else 'perdoc'}-"
+                       f"{'pipelined' if pipeline else 'serial'}"))
+    return out
+
+
+@pytest.mark.parametrize("site,shared,pipeline", _matrix_params())
 def test_crash_point_matrix_zero_acked_loss(tmp_path, site, shared,
                                             pipeline, monkeypatch):
     """One kill site per run — × {per-doc, shared} WAL streams × the
