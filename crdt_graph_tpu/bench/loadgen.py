@@ -81,6 +81,16 @@ class LoadgenConfig:
     lag_probe_every: int = 4       # every Nth acked write measures
     #                                ack→visible-on-another-replica lag
     spray_read_p: float = 0.5      # extra read via a random replica
+    # deterministic network fault injection (cluster/netchaos.py) on
+    # the fleet's INTER-NODE links — anti-entropy pulls + write
+    # forwarding.  Seeded by cfg.seed; the report carries the fired
+    # counters and the replay line.
+    netchaos_spec: Optional[str] = None
+    # ALSO run the session/giant client links through the plan (links
+    # named by session id, targetable by part= groups).  The harness'
+    # own quiesce/verification requests always stay clean so the
+    # convergence checks measure the fleet, not the harness' luck.
+    netchaos_clients: bool = False
 
 
 class _Session(threading.Thread):
@@ -522,10 +532,14 @@ def _opsaxis_report():
 class _FleetHarness:
     def __init__(self, cfg: LoadgenConfig,
                  oracle: oracle_mod.SessionOracle):
-        from ..cluster import MemoryKV
+        from ..cluster import MemoryKV, NetChaos
         self.cfg = cfg
         self.oracle = oracle
         self.kv = MemoryKV()
+        # one shared fault plan models ONE network for the whole
+        # in-process fleet (link decision streams are per (src, dst))
+        self.netchaos = NetChaos(cfg.seed, cfg.netchaos_spec) \
+            if cfg.netchaos_spec else None
         self.servers: Dict[str, Any] = {}       # live name -> FleetServer
         self.dead: List[str] = []
         self.lock = threading.Lock()
@@ -549,7 +563,8 @@ class _FleetHarness:
         fs = FleetServer(name, self.kv, engine=engine,
                          ttl_s=self.cfg.lease_ttl_s,
                          ae_interval_s=self.cfg.ae_interval_s,
-                         delta_cap=self.cfg.delta_cap)
+                         delta_cap=self.cfg.delta_cap,
+                         netchaos=self.netchaos)
         node = fs.node
 
         def listen(rec):
@@ -604,8 +619,21 @@ class _FleetHarness:
     # -- transport --------------------------------------------------------
 
     def request(self, fs, method: str, path: str, body=None,
-                headers=None, timeout: float = 60.0):
-        conn = HTTPConnection("127.0.0.1", fs.port, timeout=timeout)
+                headers=None, timeout: float = 60.0,
+                chaos_src: Optional[str] = None):
+        """One request to a fleet member.  ``chaos_src`` (a client
+        link name) routes it through the armed fault plan — session
+        traffic under ``netchaos_clients``; harness verification
+        requests never pass it."""
+        if chaos_src is not None and self.netchaos is not None \
+                and self.cfg.netchaos_clients:
+            from ..cluster import netchaos as netchaos_mod
+            conn = netchaos_mod.connect(self.netchaos, chaos_src,
+                                        fs.name, "127.0.0.1", fs.port,
+                                        timeout)
+        else:
+            conn = HTTPConnection("127.0.0.1", fs.port,
+                                  timeout=timeout)
         try:
             conn.request(method, path, body=body, headers=headers or {})
             resp = conn.getresponse()
@@ -700,7 +728,8 @@ class _FleetSession(threading.Thread):
                 resp, raw = self.h.request(
                     fs, "POST", f"/docs/{self.doc}/ops", body=body,
                     headers={TRACE_HEADER: tid,
-                             SESSION_HEADER: self.sid})
+                             SESSION_HEADER: self.sid},
+                    chaos_src=self.sid)
             except (OSError, HTTPException):
                 self._rotate_and_repush()
                 continue
@@ -753,7 +782,8 @@ class _FleetSession(threading.Thread):
                     fs, "POST", f"/docs/{self.doc}/ops", body=body,
                     headers={TRACE_HEADER:
                              f"{self.sid}-rp{k:04d}-{self.rng.randrange(16**4):04x}",
-                             SESSION_HEADER: self.sid})
+                             SESSION_HEADER: self.sid},
+                    chaos_src=self.sid)
             except (OSError, HTTPException):
                 return                    # next _post attempt rotates
 
@@ -763,7 +793,8 @@ class _FleetSession(threading.Thread):
         try:
             resp, raw = self.h.request(
                 fs, "GET", f"/docs/{self.doc}",
-                headers={SESSION_HEADER: self.sid})
+                headers={SESSION_HEADER: self.sid},
+                chaos_src=self.sid)
         except (OSError, HTTPException):
             return False
         ms = (time.perf_counter() - t0) * 1e3
@@ -815,7 +846,7 @@ class _FleetSession(threading.Thread):
             try:
                 resp, raw = self.h.request(
                     fs, "POST", f"/docs/{self.doc}/replicas",
-                    timeout=30)
+                    timeout=30, chaos_src=self.sid)
             except (OSError, HTTPException):
                 self.entry = "?"            # re-pick a survivor
                 time.sleep(0.1)
@@ -951,7 +982,8 @@ def _fleet_giant(h: _FleetHarness, state: Dict[str, Any]) -> None:
                 resp, raw = h.request(
                     fs, "POST", "/docs/load0/ops", body=body,
                     headers={TRACE_HEADER: f"giant-fleet-{attempt:03d}",
-                             SESSION_HEADER: sid}, timeout=600)
+                             SESSION_HEADER: sid}, timeout=600,
+                    chaos_src=sid)
             except (OSError, HTTPException):
                 time.sleep(0.2)
                 continue
@@ -1140,6 +1172,12 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         "violations": violations,
         "prom_cluster_families": sorted(
             f for f in fams if f.startswith("crdt_cluster_")),
+        # the replay line + fired-fault counters of the armed network
+        # fault plan (None = clean links)
+        "netchaos": h.netchaos.stats() if h.netchaos is not None
+        else None,
+        "netchaos_replay": h.netchaos.describe()
+        if h.netchaos is not None else None,
         "errors": errors[:12],
     }
 

@@ -21,7 +21,13 @@ fleet of peers instead of a privileged process:
 - :mod:`~crdt_graph_tpu.cluster.gateway` — the store the HTTP layer
   serves: any server accepts any request, writes forward to the doc's
   primary, reads serve the LOCAL replica snapshot with honest
-  ``X-Replica-*`` / ``X-State-Fingerprint`` headers.
+  ``X-Replica-*`` / ``X-State-Fingerprint`` / ``X-Ae-Lag-Seconds``
+  headers;
+- :mod:`~crdt_graph_tpu.cluster.netchaos` — deterministic network
+  fault injection for every inter-node client path: seeded drop /
+  delay / throttle / cut / dup faults and scheduled partition
+  matrices (``GRAFT_NETCHAOS``), so a partition test is a replayable
+  artifact.
 
 Run one node: ``python -m crdt_graph_tpu.cluster --name n0
 --kv-dir /tmp/fleet --port 8931``.
@@ -30,8 +36,11 @@ from .antientropy import AntiEntropy
 from .gateway import ClusterNode, FleetServer, ForwardError
 from .kv import FileKV, MemoryKV
 from .lease import Lease, LeaseError, LeaseLost, LeaseService
+from .netchaos import ChaosHTTPConnection, NetChaos, NetChaosSpecError
 from .ring import HashRing
 
-__all__ = ["AntiEntropy", "ClusterNode", "FileKV", "FleetServer",
+__all__ = ["AntiEntropy", "ChaosHTTPConnection", "ClusterNode",
+           "FileKV", "FleetServer",
            "ForwardError", "HashRing", "Lease", "LeaseError",
-           "LeaseLost", "LeaseService", "MemoryKV"]
+           "LeaseLost", "LeaseService", "MemoryKV", "NetChaos",
+           "NetChaosSpecError"]

@@ -44,6 +44,21 @@ def main(argv=None) -> int:
                          "round covers all documents (GRAFT_WAL_SHARED; "
                          "docs/DURABILITY.md §Shared WAL) — the "
                          "many-small-docs fleet shape")
+    ap.add_argument("--netchaos", default=None,
+                    help="deterministic network fault plan "
+                         "('<seed>:<spec>', cluster/netchaos.py "
+                         "grammar) for this node's OUTBOUND fleet "
+                         "links; equivalent to GRAFT_NETCHAOS")
+    ap.add_argument("--max-staleness", type=float, default=None,
+                    help="server-wide bounded-staleness read default "
+                         "in seconds (GRAFT_MAX_STALENESS_S): reads "
+                         "on a replica whose anti-entropy lag exceeds "
+                         "it answer 503 + Retry-After")
+    ap.add_argument("--scrub-interval", type=float, default=None,
+                    help="cold-file checksum scrub cadence in seconds "
+                         "(GRAFT_SCRUB_INTERVAL_S; 0 = off): corrupt "
+                         "segments quarantine and heal from fleet "
+                         "peers (docs/DURABILITY.md §Scrub & repair)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin this node to the host CPU backend "
                          "(localhost test fleets: scrubs the TPU "
@@ -60,7 +75,16 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_enable_x64", True)
 
-    from . import FileKV, FleetServer
+    import os
+
+    if args.scrub_interval is not None:
+        os.environ["GRAFT_SCRUB_INTERVAL_S"] = str(args.scrub_interval)
+
+    from . import FileKV, FleetServer, NetChaos
+
+    chaos = None
+    if args.netchaos:
+        chaos = NetChaos.parse(args.netchaos)
 
     engine = None
     if args.durable_dir:
@@ -70,10 +94,13 @@ def main(argv=None) -> int:
                                wal_sync=args.wal_sync,
                                wal_shared=args.wal_shared,
                                flight=flight_mod.FlightRecorder())
+    node_kw = {}
+    if args.max_staleness is not None:
+        node_kw["max_staleness_s"] = args.max_staleness
     fs = FleetServer(args.name, FileKV(args.kv_dir), port=args.port,
-                     engine=engine,
+                     engine=engine, netchaos=chaos,
                      ttl_s=args.ttl, ae_interval_s=args.ae_interval,
-                     delta_cap=args.delta_cap)
+                     delta_cap=args.delta_cap, **node_kw)
     print("READY " + json.dumps(
         {"name": fs.name, "addr": fs.addr,
          "id": fs.node.node_id(), "epoch": fs.node.epoch(),

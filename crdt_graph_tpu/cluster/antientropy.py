@@ -18,6 +18,23 @@ Failure shape (docs/CLUSTER.md §Failure matrix):
 - **peer down** — per-peer exponential backoff with jitter
   (``base·2^k``, capped), reset on the first successful round; the
   daemon never blocks on a dead peer longer than the HTTP timeout;
+- **peer persistently down / partitioned** — a per-peer CIRCUIT
+  BREAKER layered over the backoff (docs/CLUSTER.md §Partitions &
+  staleness): past ``breaker_threshold`` consecutive failures the
+  breaker opens and full sync rounds stop against that peer; only
+  bounded PROBE pulls (the ``/docs`` listing plus at most one window
+  of at most one document) fire — on the capped backoff cadence, or
+  immediately on a priority wake, which during an open breaker
+  performs exactly one probe rather than a full unthrottled round.
+  A successful probe closes the breaker and the next round resumes
+  full sync.  A ``health`` EWMA (1.0 = perfect) summarizes each
+  peer's recent success rate for the ``crdt_peer_health`` gauge;
+- **partition staleness is wire-observable** — :meth:`AntiEntropy.
+  lag_seconds` (the max seconds since ANY live peer was last fully
+  synced) is stamped on every fleet read as ``X-Ae-Lag-Seconds``,
+  and a read carrying a staleness bound gets 503 instead of silently
+  stale data when the replica is partitioned past it
+  (cluster/gateway.py ``check_staleness``);
 - **peer restarted with an empty log** — the peer answers
   ``X-Since-Found: 0`` for a mark it no longer knows; the puller
   resets that mark to 0 and re-pulls from scratch (duplicates absorb)
@@ -51,8 +68,14 @@ from ..obs.trace import (AE_PEER_HEADER, SINCE_FOUND_HEADER,
                          SINCE_MORE_HEADER, SINCE_NEXT_HEADER)
 from ..serve.metrics import Histogram, LATENCY_BOUNDS_MS
 from ..serve.queue import QueueFull, SchedulerStopped
+from . import netchaos as netchaos_mod
 
 EMPTY_BATCH = b'{"op":"batch","ops":[]}'
+
+# health EWMA weight: score = (1-w)·score + w·outcome — ~8 recent
+# outcomes dominate, so a healed peer recovers visibly within a few
+# rounds and one blip doesn't tank a healthy link
+_HEALTH_W = 0.2
 
 
 class _PeerFailure(Exception):
@@ -60,12 +83,23 @@ class _PeerFailure(Exception):
 
 
 class _PeerState:
-    __slots__ = ("addr", "hw", "hw_digest", "pulls", "ops_applied",
-                 "dup_windows_skipped", "failures", "fail_streak",
-                 "backoff_until", "last_ok", "last_err", "known_docs")
+    __slots__ = ("name", "addr", "hw", "hw_digest", "pulls",
+                 "ops_applied", "dup_windows_skipped", "failures",
+                 "fail_streak", "backoff_until", "last_ok", "last_err",
+                 "known_docs", "health", "breaker_opens", "probes")
 
-    def __init__(self, addr: str):
+    def __init__(self, name: str, addr: str):
+        self.name = name
         self.addr = addr
+        # partition-aware degradation (docs/CLUSTER.md §Partitions &
+        # staleness): success-rate EWMA + circuit-breaker telemetry.
+        # The breaker itself is DERIVED state — open iff fail_streak
+        # >= the daemon's threshold — so closing it is exactly the
+        # existing first-success streak reset, never a second flag
+        # that could disagree with it.
+        self.health = 1.0
+        self.breaker_opens = 0
+        self.probes = 0
         self.hw: Dict[str, int] = {}     # doc -> last Add ts served
         # the peer's /docs listing from the last successful round —
         # how a rejoining node knows a document it doesn't hold yet
@@ -99,6 +133,7 @@ class AntiEntropy(threading.Thread):
                  jitter: float = 0.25,
                  http_timeout_s: float = 15.0,
                  max_windows_per_doc: int = 10_000,
+                 breaker_threshold: int = 5,
                  seed: Optional[int] = None):
         super().__init__(name=f"antientropy-{node.name}", daemon=True)
         self.node = node
@@ -109,6 +144,9 @@ class AntiEntropy(threading.Thread):
         self.jitter = jitter
         self.http_timeout_s = http_timeout_s
         self.max_windows_per_doc = max_windows_per_doc
+        # consecutive failures before the peer's circuit breaker opens
+        # (full rounds stop; only probes fire on the backoff cadence)
+        self.breaker_threshold = max(1, int(breaker_threshold))
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -120,7 +158,11 @@ class AntiEntropy(threading.Thread):
         self._trace_n = 0
         self.local_shed = 0
         self.priority_pulls = 0
+        self.probe_pulls = 0
         self._last_priority_wake = 0.0
+        # the doc a priority wake asked for: an open-breaker peer's
+        # probe pulls THIS doc (one window) instead of a full round
+        self._priority_doc: Optional[str] = None
         self.started_at = time.monotonic()
 
     # -- lifecycle --------------------------------------------------------
@@ -140,6 +182,7 @@ class AntiEntropy(threading.Thread):
         now = time.monotonic()
         with self._lock:
             self.priority_pulls += 1
+            self._priority_doc = doc
             if now - self._last_priority_wake < 1.0:
                 return
             self._last_priority_wake = now
@@ -169,15 +212,29 @@ class AntiEntropy(threading.Thread):
             t0 = time.perf_counter()
             results: Dict[str, bool] = {}
             now = time.monotonic()
+            with self._lock:
+                priority_doc, self._priority_doc = \
+                    self._priority_doc, None
             members = self.node.members()
             for name, lease in sorted(members.items()):
                 if name == self.node.name:
                     continue
                 st = self._peer_state(name, lease.addr)
+                tripped = st.fail_streak >= self.breaker_threshold
                 if respect_backoff and now < st.backoff_until:
                     continue
                 try:
-                    self._sync_peer(st)
+                    if tripped:
+                        # open circuit breaker: never a full round —
+                        # one bounded probe (listing + at most one
+                        # window of at most one doc), fired on the
+                        # capped backoff cadence or, right now, by a
+                        # priority wake (respect_backoff=False).  A
+                        # success closes the breaker below; the NEXT
+                        # round resumes full sync.
+                        self._probe_peer(st, priority_doc)
+                    else:
+                        self._sync_peer(st)
                 except (_PeerFailure, OSError, HTTPException,
                         ValueError, json.JSONDecodeError) as e:
                     # HTTPException: the peer died mid-response
@@ -187,9 +244,24 @@ class AntiEntropy(threading.Thread):
                     results[name] = False
                 else:
                     with self._lock:
+                        # first success fully resets the failure
+                        # machinery: streak, backoff, AND (because the
+                        # breaker is derived from the streak) the open
+                        # circuit — pinned by the backoff-hygiene test
                         st.fail_streak = 0
                         st.backoff_until = 0.0
-                        st.last_ok = time.monotonic()
+                        # the lag clock resets on FULL rounds only: a
+                        # successful PROBE proves reachability, not
+                        # sync — minutes of unpulled writes may remain
+                        # behind it, and lag_seconds() feeding the
+                        # bounded-staleness 503 must not report ~0
+                        # until the next full round actually pulled
+                        # everything
+                        if not tripped:
+                            st.last_ok = time.monotonic()
+                        st.health = min(
+                            1.0, (1 - _HEALTH_W) * st.health
+                            + _HEALTH_W)
                     results[name] = True
             # fold the marks peers have pulled against US into the
             # per-doc stability watermark, then let the cascade op-log
@@ -209,7 +281,7 @@ class AntiEntropy(threading.Thread):
         with self._lock:
             st = self._peers.get(name)
             if st is None:
-                st = self._peers[name] = _PeerState(addr)
+                st = self._peers[name] = _PeerState(name, addr)
             elif st.addr != addr:
                 # the peer restarted on a new port: its log may be
                 # fresh too — the marks stay (X-Since-Found resets any
@@ -221,18 +293,41 @@ class AntiEntropy(threading.Thread):
         with self._lock:
             st.failures += 1
             st.fail_streak += 1
+            st.health = (1 - _HEALTH_W) * st.health
+            if st.fail_streak == self.breaker_threshold:
+                st.breaker_opens += 1
             st.last_err = repr(e)
+            # the exponent is clamped: a peer dead for hours reaches
+            # streaks where an unbounded 2**n overflows float and the
+            # raise would abort the whole sync round
             delay = min(self.backoff_max_s,
-                        self.backoff_base_s * 2 ** (st.fail_streak - 1))
+                        self.backoff_base_s
+                        * 2 ** min(st.fail_streak - 1, 32))
             delay *= 1.0 + self.jitter * self._rng.random()
             st.backoff_until = time.monotonic() + delay
 
+    def breaker_open(self, name: str) -> bool:
+        """Whether ``name``'s circuit breaker is currently open — the
+        scrub repair path avoids fetching through a peer the daemon
+        already knows is down/partitioned."""
+        with self._lock:
+            st = self._peers.get(name)
+            return st is not None \
+                and st.fail_streak >= self.breaker_threshold
+
     # -- the wire ---------------------------------------------------------
 
-    def _sync_peer(self, st: _PeerState) -> None:
+    def _connect(self, st: _PeerState, peer: str) -> HTTPConnection:
+        """Outbound connection to a peer, through the node's armed
+        fault plan (cluster/netchaos.py) when one exists — chaos rides
+        the SAME link the real traffic does."""
         host, port = st.addr.rsplit(":", 1)
-        conn = HTTPConnection(host, int(port),
-                              timeout=self.http_timeout_s)
+        return netchaos_mod.connect(
+            getattr(self.node, "netchaos", None), self.node.name,
+            peer, host, int(port), self.http_timeout_s)
+
+    def _sync_peer(self, st: _PeerState) -> None:
+        conn = self._connect(st, st.name)
         try:
             conn.request("GET", "/docs")
             resp = conn.getresponse()
@@ -247,9 +342,36 @@ class AntiEntropy(threading.Thread):
         finally:
             conn.close()
 
+    def _probe_peer(self, st: _PeerState,
+                    priority_doc: Optional[str]) -> None:
+        """The open-breaker probe: refresh the peer's ``/docs``
+        listing and pull AT MOST ONE window of AT MOST ONE document
+        (the priority doc when the peer holds it, else the first
+        listed) — never the full unthrottled round a blind priority
+        wake used to run against a down peer."""
+        with self._lock:
+            st.probes += 1
+            self.probe_pulls += 1
+        conn = self._connect(st, st.name)
+        try:
+            conn.request("GET", "/docs")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise _PeerFailure(f"GET /docs -> {resp.status}")
+            docs = json.loads(body)["docs"]
+            with self._lock:
+                st.known_docs = frozenset(docs)
+            probe = priority_doc if priority_doc in docs else \
+                (docs[0] if docs else None)
+            if probe is not None:
+                self._pull_doc(conn, st, probe, max_windows=1)
+        finally:
+            conn.close()
+
     def _pull_doc(self, conn: HTTPConnection, st: _PeerState,
-                  doc: str) -> None:
-        for _ in range(self.max_windows_per_doc):
+                  doc: str, max_windows: Optional[int] = None) -> None:
+        for _ in range(max_windows or self.max_windows_per_doc):
             since = st.hw.get(doc, 0)
             # the pull names its node: the peer folds this mark into
             # its causal-stability watermark (the gate on its op-log's
@@ -289,6 +411,8 @@ class AntiEntropy(threading.Thread):
                 st.hw[doc] = int(nxt)
             if resp.getheader(SINCE_MORE_HEADER) != "1":
                 return
+        if max_windows is not None:
+            return      # bounded probe: the rest waits for a full round
         raise _PeerFailure(f"doc {doc!r}: window chain exceeded "
                            f"{self.max_windows_per_doc}")
 
@@ -318,6 +442,38 @@ class AntiEntropy(threading.Thread):
             raise _PeerFailure(f"local apply rejected window of "
                                f"doc {doc!r}")
         return op_mod.count(applied)
+
+    def lag_seconds(self) -> float:
+        """Replication lag upper bound: the MAX seconds since any live
+        lease-table peer was last fully synced (0.0 with no peers).
+        A member NEVER fully synced since daemon start is ``inf`` —
+        a replica restarted after an hour of downtime cannot bound how
+        stale its durable state is, and stamping a start-relative
+        near-zero would be exactly the silent-stale lie the 503
+        exists to prevent (prom renders the gauge as ``+Inf``; a
+        bounded read refuses until the first full round lands).
+        Stamped on every fleet read as ``X-Ae-Lag-Seconds`` and
+        compared against the bounded-staleness read contract (gateway
+        ``check_staleness``): if the fleet held writes we haven't
+        pulled, they are at most this old — a partitioned replica's
+        lag grows without bound until the link heals."""
+        now = time.monotonic()
+        # the ring's TTL-cached membership snapshot, NOT a fresh KV
+        # lease scan — this runs on every fleet read (the lag stamp)
+        names = self.node.live_member_names() \
+            if hasattr(self.node, "live_member_names") \
+            else self.node.members()
+        members = set(names) - {self.node.name}
+        if not members:
+            return 0.0
+        lag = 0.0
+        with self._lock:
+            for name in members:
+                st = self._peers.get(name)
+                if st is None or st.last_ok is None:
+                    return float("inf")
+                lag = max(lag, now - st.last_ok)
+        return lag
 
     def peers_with(self, doc: str) -> list:
         """Live-peer names whose last ``/docs`` listing included
@@ -353,6 +509,13 @@ class AntiEntropy(threading.Thread):
                                else self.started_at), 3),
                     "docs_tracked": len(st.hw),
                     "last_err": st.last_err,
+                    # partition-aware degradation surface
+                    # (docs/CLUSTER.md §Partitions & staleness)
+                    "health": round(st.health, 4),
+                    "breaker_open":
+                        st.fail_streak >= self.breaker_threshold,
+                    "breaker_opens": st.breaker_opens,
+                    "probes": st.probes,
                 }
                 for name, st in sorted(self._peers.items())
             }
@@ -364,5 +527,7 @@ class AntiEntropy(threading.Thread):
                 "round_ms_export": self.round_ms.export(),
                 "local_shed": self.local_shed,
                 "priority_pulls": self.priority_pulls,
+                "probe_pulls": self.probe_pulls,
+                "breaker_threshold": self.breaker_threshold,
                 "peers": peers,
             }

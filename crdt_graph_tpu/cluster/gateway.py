@@ -40,11 +40,16 @@ from typing import Dict, List, Optional, Tuple
 
 from ..obs import flight as flight_mod
 from ..obs import prom as prom_mod
-from ..obs.trace import (FORWARDED_HEADER, REPLICA_EPOCH_HEADER,
+from ..obs.trace import (AE_LAG_HEADER, FORWARDED_HEADER,
+                         REPLICA_EPOCH_HEADER,
                          REPLICA_HEADER, REPLICA_NAME_HEADER,
-                         SESSION_HEADER, STATE_FP_HEADER, TRACE_HEADER)
+                         SESSION_HEADER, SINCE_FOUND_HEADER,
+                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER,
+                         STATE_FP_HEADER, TRACE_HEADER)
 from ..serve import ServingEngine
+from ..utils.hostenv import env_float as _env_float
 from . import kv as kv_mod
+from . import netchaos as netchaos_mod
 from .antientropy import AntiEntropy
 from .lease import Lease, LeaseKeeper, LeaseService
 from .ring import HashRing
@@ -81,10 +86,35 @@ class ClusterNode:
                  delta_cap: int = 65_536,
                  forward_retries: int = 4,
                  forward_timeout_s: float = 30.0,
+                 forward_budget_s: Optional[float] = None,
+                 max_staleness_s: Optional[float] = None,
+                 breaker_threshold: int = 5,
+                 netchaos=None,
                  vnodes: int = 64,
                  clock=time.time):
         self.name = name
         self.kv = kv
+        # deterministic network fault injection (cluster/netchaos.py):
+        # an explicitly armed plan, else the process-wide
+        # GRAFT_NETCHAOS one, else None (clean links).  Every outbound
+        # fleet connection — anti-entropy, forwarding, repair fetches
+        # — rides through it.
+        self.netchaos = netchaos if netchaos is not None \
+            else netchaos_mod.env_chaos()
+        # end-to-end write-forwarding deadline: the retry loop never
+        # pins a client handler past this budget — exhausted, the
+        # client gets 503 + Retry-After (ForwardError) and retries
+        # into failover.  (The old shape, retries × timeout with no
+        # total cap, could hold a handler for 2 minutes.)
+        self.forward_budget_s = forward_budget_s \
+            if forward_budget_s is not None \
+            else _env_float("GRAFT_FORWARD_BUDGET_S", 45.0)
+        # bounded-staleness server default (0 = reads are never
+        # staleness-rejected unless the request carries its own
+        # X-Max-Staleness bound)
+        self.max_staleness_s = max_staleness_s \
+            if max_staleness_s is not None \
+            else _env_float("GRAFT_MAX_STALENESS_S", 0.0)
         # each node owns its OWN flight recorder: in-process fleets
         # must not interleave three servers' commit records in one
         # process-wide ring (the oracle tags records per node)
@@ -106,21 +136,31 @@ class ClusterNode:
                                    clock=clock)
         self.lease: Optional[Lease] = None
         self.keeper: Optional[LeaseKeeper] = None
-        self.antientropy = AntiEntropy(self, interval_s=ae_interval_s,
-                                       delta_cap=delta_cap)
+        self.antientropy = AntiEntropy(
+            self, interval_s=ae_interval_s, delta_cap=delta_cap,
+            breaker_threshold=breaker_threshold)
+        # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
+        # the maintenance lane's scrub task heals a quarantined range
+        # by re-fetching it from a fleet peer through this hook
+        self.engine.repair_fetcher = self.repair_fetch
         self.forward_retries = forward_retries
         self.forward_timeout_s = forward_timeout_s
         self.vnodes = vnodes
         self._ring_ttl_s = ring_ttl_s
         self._ring_lock = threading.Lock()
         self._ring: Optional[HashRing] = None
+        self._member_names: frozenset = frozenset()
         self._ring_at = 0.0
         self._counter_lock = threading.Lock()
         self.counters: Dict[str, int] = {
             "forwarded_ok": 0, "forwarded_err": 0,
             "forward_retries": 0, "forwarded_in": 0,
+            "forward_budget_exhausted": 0,
             "replica_ids_assigned": 0,
+            "staleness_503": 0,
+            "repair_fetches": 0, "repair_fetch_failures": 0,
         }
+        self._last_repair_err: Optional[str] = None
         self.started_at = time.monotonic()
 
     # -- lifecycle --------------------------------------------------------
@@ -172,6 +212,7 @@ class ClusterNode:
             members = {name: lease.addr
                        for name, lease in self.members().items()}
             self._ring = HashRing(members, vnodes=self.vnodes)
+            self._member_names = frozenset(members)
             self._ring_at = time.monotonic()
             return self._ring
 
@@ -182,19 +223,30 @@ class ClusterNode:
             return self.refresh_ring()
         return ring
 
+    def live_member_names(self) -> frozenset:
+        """The lease table's member names through the ring's TTL cache
+        — the per-read lag stamp (``lag_seconds``) must not pay a full
+        KV lease scan on every GET."""
+        self.ring()
+        with self._ring_lock:
+            return self._member_names
+
     def primary_for(self, doc_id: str) -> Optional[str]:
         return self.ring().primary(doc_id)
 
-    def write_route(self, doc_id: str) -> Optional[str]:
-        """Address to forward a client write to, or None when THIS
-        node should apply it (we are primary, we are the only member,
-        or we are not in the ring at all — then local apply +
-        anti-entropy is strictly better than guessing)."""
+    def write_route(self, doc_id: str
+                    ) -> Optional[Tuple[str, str]]:
+        """``(name, addr)`` of the primary to forward a client write
+        to, or None when THIS node should apply it (we are primary, we
+        are the only member, or we are not in the ring at all — then
+        local apply + anti-entropy is strictly better than guessing).
+        Name and address come from ONE ring snapshot, so the netchaos
+        link label always matches the peer actually dialed."""
         ring = self.ring()
         primary = ring.primary(doc_id)
         if primary is None or primary == self.name:
             return None
-        return ring.address(primary)
+        return primary, ring.address(primary)
 
     # -- write forwarding --------------------------------------------------
 
@@ -208,19 +260,34 @@ class ClusterNode:
         """Relay one client write to the document's primary.  Returns
         ``(status, body, headers)`` to answer with, or None when the
         caller should apply locally (we are/became the primary).
-        Raises :class:`ForwardError` after the retry budget."""
+        Raises :class:`ForwardError` after the retry budget — or after
+        the END-TO-END deadline (``forward_budget_s``): each attempt's
+        timeout is clipped to the remaining budget, so the loop can
+        never pin a client handler for retries × timeout."""
         detail = "no attempt"
+        deadline = time.monotonic() + self.forward_budget_s
         for attempt in range(self.forward_retries):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                detail = (f"forward budget "
+                          f"({self.forward_budget_s:.0f}s) exhausted "
+                          f"after {attempt} attempts: {detail}")
+                self._count("forward_budget_exhausted")
+                break
             if attempt:
                 self._count("forward_retries")
-                time.sleep(min(0.25, 0.05 * (2 ** (attempt - 1))))
+                time.sleep(min(0.25, 0.05 * (2 ** (attempt - 1)),
+                               max(0.0, remaining)))
                 self.refresh_ring()
-            addr = self.write_route(doc_id)
-            if addr is None:
+            route = self.write_route(doc_id)
+            if route is None:
                 return None
+            primary, addr = route
             host, port = addr.rsplit(":", 1)
-            conn = HTTPConnection(host, int(port),
-                                  timeout=self.forward_timeout_s)
+            conn = netchaos_mod.connect(
+                self.netchaos, self.name, primary, host, int(port),
+                min(self.forward_timeout_s,
+                    max(0.05, deadline - time.monotonic())))
             try:
                 fwd = {"Content-Type": "application/json",
                        FORWARDED_HEADER: f"{self.name}.{self.epoch()}"}
@@ -253,13 +320,72 @@ class ClusterNode:
 
     # -- fleet identity on the wire ---------------------------------------
 
-    def extra_read_headers(self, snap) -> Dict[str, str]:
+    def extra_read_headers(self, snap,
+                           ae_lag_hdr: Optional[str] = None
+                           ) -> Dict[str, str]:
         return {
             REPLICA_HEADER: str(self.node_id()),
             REPLICA_NAME_HEADER: self.name,
             REPLICA_EPOCH_HEADER: str(self.epoch()),
             STATE_FP_HEADER: snap.state_fingerprint(),
+            # the bounded-staleness contract's observable half: how
+            # stale this replica can possibly be, from the
+            # anti-entropy marks (docs/CLUSTER.md §Partitions &
+            # staleness).  A gated read passes the gate's own sample
+            # through (``ae_lag_hdr``) so the stamp can never disagree
+            # with the bound it was served under — and the lag is
+            # computed once per request, not once per consumer.
+            AE_LAG_HEADER: ae_lag_hdr if ae_lag_hdr is not None
+            else f"{self.ae_lag_seconds():.3f}",
         }
+
+    def ae_lag_seconds(self) -> float:
+        return self.antientropy.lag_seconds()
+
+    def check_staleness(self, bound_header: Optional[str]
+                        ) -> Tuple[Optional[Dict], str]:
+        """Bounded-staleness read gate (service/http.py consults it
+        before serving a fleet read): the effective bound is the
+        request's ``X-Max-Staleness`` (seconds) when well-formed, else
+        the server-wide ``GRAFT_MAX_STALENESS_S`` default; 0/absent =
+        unbounded, ``+inf`` an explicit unbounded request that
+        overrides even a strict default.  Returns ``(verdict,
+        lag_header)``: verdict None to serve, else the 503 payload —
+        honest refusal instead of silently stale data while
+        partitioned.  ``lag_header`` is the ``X-Ae-Lag-Seconds`` stamp
+        from the SAME lag sample the gate judged, and the payload's
+        ``lag_s`` is JSON-safe: None (never ``Infinity``, which is not
+        RFC 8259 JSON) when the lag is unbounded — a replica that has
+        never fully synced since daemon start."""
+        import math
+        lag = self.ae_lag_seconds()
+        lag_hdr = f"{lag:.3f}"          # inf formats as "inf"
+        bound = None
+        if bound_header:
+            try:
+                bound = float(bound_header)
+            except ValueError:
+                bound = None        # malformed: fall to server default
+            if bound is not None and not math.isfinite(bound):
+                # +inf is an EXPLICIT unbounded request; nan (compares
+                # False against any lag: a permanent 503) and -inf are
+                # malformed and fall back rather than wedging the
+                # read path
+                if bound > 0:
+                    return None, lag_hdr
+                bound = None
+        if bound is None:
+            bound = self.max_staleness_s
+        if not bound or bound <= 0:
+            return None, lag_hdr
+        if lag <= bound:
+            return None, lag_hdr
+        self._count("staleness_503")
+        retry = max(1, min(30, int(
+            self.antientropy.interval_s * 2 + 0.999)))
+        return {"lag_s": round(lag, 3) if math.isfinite(lag)
+                else None,
+                "bound_s": bound, "retry_after_s": retry}, lag_hdr
 
     def served_by(self) -> Dict[str, object]:
         """Write-response attribution (the committing node)."""
@@ -295,6 +421,105 @@ class ClusterNode:
         self.antientropy.request_priority(doc_id)
         retry = max(1, int(self.antientropy.interval_s * 2 + 0.999))
         return {"retry_after_s": retry, "remaining": len(peers)}
+
+    # -- scrub peer repair (docs/DURABILITY.md §Scrub & repair) ------------
+
+    def repair_fetch(self, doc_id: str, spec: Dict[str, int]):
+        """Re-fetch the op rows a quarantined tier file covered from a
+        fleet peer, through the ORDINARY ``packed_since_window`` wire
+        (no new protocol): ``spec`` names the global row range
+        ``[start, stop)`` plus the window-chain entry point — ``since``
+        (the last Add timestamp strictly before ``start``, from the
+        neighboring tiers' resident indexes) and ``p0`` (that Add's
+        global position; 0/0 when the range starts the log).  Returns
+        a ``PackedOps`` of exactly ``stop-start`` rows or None (peer
+        down, diverged, or still behind — the quarantine stands and
+        the next scrub retries).  Peers with an open circuit breaker
+        are skipped: the daemon already knows they're unreachable."""
+        peers = self.antientropy.peers_with(doc_id)
+        members = self.members()
+        for peer in peers:
+            if self.antientropy.breaker_open(peer):
+                continue
+            lease = members.get(peer)
+            if lease is None:
+                continue
+            try:
+                rows = self._fetch_range(peer, lease.addr, doc_id,
+                                         spec)
+            except (OSError, HTTPException, ValueError, KeyError,
+                    IndexError) as e:
+                self._last_repair_err = repr(e)
+                rows = None
+            if rows is not None:
+                self._count("repair_fetches")
+                return rows
+        self._count("repair_fetch_failures")
+        return None
+
+    def _fetch_range(self, peer: str, addr: str, doc_id: str,
+                     spec: Dict[str, int]):
+        """One peer's window chain → the requested row range.  Windows
+        resume on the inclusive Add terminator, so every window after
+        the first overlaps the previous by exactly its first row."""
+        import numpy as np
+
+        from ..codec import packed as packed_mod
+        start, stop = int(spec["start"]), int(spec["stop"])
+        since, pos = int(spec["since"]), int(spec["p0"])
+        p0 = pos
+        host, port = addr.rsplit(":", 1)
+        pieces = []
+        first = True
+        conn = netchaos_mod.connect(
+            self.netchaos, self.name, peer, host, int(port),
+            self.forward_timeout_s)
+        try:
+            for _ in range(self.antientropy.max_windows_per_doc):
+                if pos >= stop:
+                    break
+                conn.request(
+                    "GET", f"/docs/{doc_id}/ops?since={since}"
+                           f"&limit={self.antientropy.delta_cap}")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                if resp.getheader(SINCE_FOUND_HEADER) == "0":
+                    # the peer no longer resolves our mark (fresh log
+                    # after a restart): its rows are not OUR rows
+                    return None
+                p = packed_mod.pack_json(body)
+                n = p.num_ops
+                skip = 0 if first else 1
+                first = False
+                if n > skip:
+                    piece = p if skip == 0 else packed_mod.select_rows(
+                        p, np.arange(skip, n))
+                    pieces.append(piece)
+                    pos += n - skip
+                if pos >= stop:
+                    break
+                nxt = resp.getheader(SINCE_NEXT_HEADER)
+                if resp.getheader(SINCE_MORE_HEADER) != "1" \
+                        or nxt is None:
+                    # the peer's log ends before our range does — it
+                    # hasn't converged up to the corrupt rows yet
+                    return None
+                since = int(nxt)
+            else:
+                return None
+        finally:
+            conn.close()
+        if pos < stop or not pieces:
+            return None
+        merged = pieces[0] if len(pieces) == 1 \
+            else packed_mod.concat_many(pieces)
+        off = start - p0
+        if off < 0 or merged.num_ops < off + (stop - start):
+            return None
+        return packed_mod.select_rows(
+            merged, np.arange(off, off + (stop - start)))
 
     # -- causal-stability watermark (cascade op-log GC gate) ---------------
 
@@ -405,6 +630,16 @@ class ClusterNode:
             "primaries": {d: ring.primary(d) for d in local_docs},
             "counters": counters,
             "antientropy": self.antientropy.stats(),
+            # JSON-safe: unbounded (never-synced) lag is null on the
+            # wire — json.dumps would emit the literal Infinity, which
+            # is not RFC 8259 JSON.  Prom re-expands None to +Inf.
+            "ae_lag_s": round(lag, 3)
+            if (lag := self.ae_lag_seconds()) != float("inf")
+            else None,
+            "max_staleness_s": self.max_staleness_s,
+            "netchaos": None if self.netchaos is None
+            else self.netchaos.stats(),
+            "last_repair_err": self._last_repair_err,
         }
 
     def cluster_view(self) -> Dict:

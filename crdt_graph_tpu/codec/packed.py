@@ -896,6 +896,39 @@ def load_packed_npz(path, light: bool = False):
     return p, meta
 
 
+def verify_packed_npz(path, expect_ops: Optional[int] = None
+                      ) -> Optional[str]:
+    """CRC-verify one packed-npz tier file WITHOUT materializing its
+    columns — the scrub pass's cheap integrity check (every npz member
+    is a zip entry with a CRC-32; a flipped bit anywhere in member
+    data fails it, a flip in the zip structure fails the open).
+    Optionally cross-checks the meta row count against the
+    descriptor's.  Returns None when healthy, else a short reason
+    string (the scrub quarantines on ANY non-None answer — missing
+    file included: lost history must never pass a scrub silently)."""
+    import json
+    import struct
+    import zipfile
+    import zlib
+    try:
+        with zipfile.ZipFile(path) as zf:
+            bad = zf.testzip()
+            if bad is not None:
+                return f"crc mismatch in member {bad!r}"
+        if expect_ops is not None:
+            z = np.load(path)
+            meta = json.loads(bytes(z["meta"]).decode())
+            n = meta.get("num_ops")
+            if n != expect_ops:
+                return (f"meta num_ops {n!r} != descriptor "
+                        f"{expect_ops}")
+    except (OSError, zipfile.BadZipFile, zlib.error, KeyError,
+            IndexError, ValueError, TypeError, EOFError,
+            struct.error) as e:
+        return f"{type(e).__name__}: {e}"
+    return None
+
+
 def pack_json(payload, max_depth: int = DEFAULT_MAX_DEPTH,
               capacity: Optional[int] = None) -> PackedOps:
     """Wire JSON (str/bytes) → :class:`PackedOps`, using the native parser

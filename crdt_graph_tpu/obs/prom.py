@@ -239,6 +239,42 @@ def render_engine(engine) -> str:
                         h["bounds"], h["counts"], h["count"], h["sum"],
                         {"doc": d.doc_id})
 
+    # -- scrub & repair (docs/DURABILITY.md §Scrub & repair) --------------
+    # rendered per tiered doc: the bit-rot sweep's verified/corrupt/
+    # repaired counters plus the live quarantined-segment gauge
+    sdocs = [(d, getattr(d, "scrub_stats", None), t)
+             for d, t in tele if t["tiered"]]
+    sdocs = [(d, st, t) for d, st, t in sdocs if st is not None]
+    if sdocs:
+        for name, help_text, key in (
+                ("crdt_scrub_runs_total",
+                 "Checksum scrub passes completed", "runs"),
+                ("crdt_scrub_files_checked_total",
+                 "Tier/matz files checksum-verified by scrub",
+                 "checked"),
+                ("crdt_scrub_corrupt_total",
+                 "Corrupt tier files found and quarantined",
+                 "corrupt"),
+                ("crdt_scrub_repaired_total",
+                 "Quarantined ranges healed from a fleet peer",
+                 "repaired"),
+                ("crdt_scrub_repair_failed_total",
+                 "Repair attempts that found no usable peer",
+                 "repair_failed"),
+                ("crdt_scrub_matz_dropped_total",
+                 "Corrupt matz artifacts dropped (re-derived at the "
+                 "next cadence)", "matz_dropped")):
+            w.family(name, "counter", help_text)
+            for d, st, t in sdocs:
+                w.sample(name, name, st[key], {"doc": d.doc_id})
+        w.family("crdt_scrub_quarantined_segments", "gauge",
+                 "Tier files currently quarantined (typed refusals "
+                 "until repaired)")
+        for d, st, t in sdocs:
+            w.sample("crdt_scrub_quarantined_segments",
+                     "crdt_scrub_quarantined_segments",
+                     t.get("quarantined", 0), {"doc": d.doc_id})
+
     # -- write-ahead log (wal.py; docs/DURABILITY.md) ---------------------
     # rendered only when at least one document is durable, so the
     # default ephemeral engine's scrape is unchanged
@@ -556,18 +592,39 @@ def render_cluster(node) -> str:
             ("forwarded_err",
              "Write forwards that exhausted the retry budget"),
             ("forward_retries", "Forward connection retries"),
+            ("forward_budget_exhausted",
+             "Forwards cut off by the end-to-end deadline budget"),
             ("forwarded_in",
              "Writes received already forwarded by a peer"),
             ("replica_ids_assigned",
-             "Fleet-unique client replica ids allocated")):
+             "Fleet-unique client replica ids allocated"),
+            ("staleness_503",
+             "Reads refused for exceeding their staleness bound"),
+            ("repair_fetches",
+             "Quarantined ranges successfully fetched from a peer"),
+            ("repair_fetch_failures",
+             "Peer-repair fetches that found no usable peer")):
         w.counter(f"crdt_cluster_{key}_total", help_text,
                   cs["counters"].get(key, 0))
+    # the bounded-staleness contract's server-side gauge: what
+    # X-Ae-Lag-Seconds stamps on every read (docs/CLUSTER.md
+    # §Partitions & staleness)
+    # cluster_stats keeps the JSON wire RFC-valid by nulling an
+    # unbounded (never-synced) lag; the prom text format has a real
+    # +Inf, so re-expand it here
+    w.gauge("crdt_cluster_ae_lag_seconds",
+            "Max seconds since any live peer was last fully synced",
+            float("inf") if cs["ae_lag_s"] is None
+            else cs["ae_lag_s"])
     ae = cs["antientropy"]
     w.counter("crdt_cluster_antientropy_rounds_total",
               "Anti-entropy rounds completed", ae["rounds"])
     w.counter("crdt_cluster_antientropy_local_shed_total",
               "Pulls shed on the local admission queue",
               ae["local_shed"])
+    w.counter("crdt_cluster_antientropy_probe_pulls_total",
+              "Bounded open-breaker probe pulls",
+              ae["probe_pulls"])
     h = ae["round_ms_export"]
     w.histogram("crdt_cluster_antientropy_round_ms",
                 "Anti-entropy round latency", h["bounds"], h["counts"],
@@ -586,11 +643,49 @@ def render_cluster(node) -> str:
         ("crdt_cluster_antientropy_backoff_seconds", "gauge",
          "Remaining backoff before the peer is retried", "backoff_s"),
     )
+    # per-peer health + circuit breaker (docs/CLUSTER.md §Partitions
+    # & staleness): the degradation surface an operator alerts on
+    peer_families = peer_families + (
+        ("crdt_peer_health", "gauge",
+         "Peer success-rate EWMA (1.0 = healthy)", "health"),
+        ("crdt_peer_breaker_open", "gauge",
+         "1 while the peer's circuit breaker is open "
+         "(probes only, no full rounds)", "breaker_open"),
+        ("crdt_peer_breaker_opens_total", "counter",
+         "Times the peer's circuit breaker tripped open",
+         "breaker_opens"),
+        ("crdt_peer_probes_total", "counter",
+         "Bounded probe pulls sent while the breaker was open",
+         "probes"),
+    )
     for fname, ftype, help_text, _ in peer_families:
         w.family(fname, ftype, help_text)
     for peer, st in ae["peers"].items():
         for fname, _, _, key in peer_families:
             w.sample(fname, fname, st[key], {"peer": peer})
+    # deterministic network fault injection (cluster/netchaos.py) —
+    # rendered only when a fault plan is armed on this node
+    nc = cs.get("netchaos")
+    if nc is not None:
+        w.gauge("crdt_netchaos_seed",
+                "Seed of the armed fault plan (replay key)",
+                nc["seed"])
+        w.gauge("crdt_netchaos_links",
+                "Distinct (src, dst) links the plan has seen",
+                nc["links"])
+        w.gauge("crdt_netchaos_blocked_links",
+                "Links currently cut by a programmatic partition",
+                nc["blocked_links"])
+        w.counter("crdt_netchaos_requests_total",
+                  "Requests that passed through the fault plan",
+                  nc["counters"]["requests"])
+        w.family("crdt_netchaos_faults_total", "counter",
+                 "Faults injected, by kind")
+        for kind in ("drops", "delays", "throttles", "cuts", "dups",
+                     "partition_blocks"):
+            w.sample("crdt_netchaos_faults_total",
+                     "crdt_netchaos_faults_total",
+                     nc["counters"][kind], {"kind": kind})
     return w.render()
 
 

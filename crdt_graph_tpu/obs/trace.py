@@ -60,6 +60,15 @@ FORWARDED_HEADER = "X-Fleet-Forwarded"
 # gates the cascade op-log's checkpoint advancement and segment GC;
 # oplog.py, cluster/gateway.py update_stability)
 AE_PEER_HEADER = "X-Ae-Peer"
+# bounded-staleness read contract (docs/CLUSTER.md §Partitions &
+# staleness): every fleet read stamps X-Ae-Lag-Seconds — the max
+# seconds since any live peer was last fully synced, i.e. an upper
+# bound on how stale this replica can be — and a read carrying
+# X-Max-Staleness (seconds; or the server-wide GRAFT_MAX_STALENESS_S
+# default) gets 503 + Retry-After instead of silently stale data when
+# the replica is partitioned past the bound
+AE_LAG_HEADER = "X-Ae-Lag-Seconds"
+MAX_STALENESS_HEADER = "X-Max-Staleness"
 # rejoining-node catch-up (ISSUE 9): a fleet read of a document this
 # node doesn't hold yet — but a peer does — answers 503 + Retry-After
 # instead of 404, with this hint: the best local estimate of the ops

@@ -431,7 +431,8 @@ class _ColdSeg:
     :class:`_SegCache`."""
 
     __slots__ = ("path", "start", "length", "add_ts", "add_pos",
-                 "file_bytes", "cache", "hints_vouched")
+                 "file_bytes", "cache", "hints_vouched",
+                 "quarantined", "index_ok")
 
     def __init__(self, path: str, start: int, length: int,
                  add_ts: np.ndarray, add_pos: np.ndarray,
@@ -445,6 +446,31 @@ class _ColdSeg:
         self.file_bytes = file_bytes
         self.cache = cache
         self.hints_vouched = hints_vouched
+        # scrub quarantine (docs/DURABILITY.md §Scrub & repair): a
+        # descriptor whose file failed its checksum scrub refuses to
+        # load — typed error, never corrupt bytes — until peer repair
+        # swaps a re-fetched, re-sealed file in.  ``index_ok`` is
+        # False only for placeholders reopened from a quarantined
+        # manifest entry (their resident add index was never built
+        # from healthy bytes, so a repair can't be cross-checked
+        # against it).
+        self.quarantined = False
+        self.index_ok = True
+
+    @staticmethod
+    def placeholder(path: str, start: int, length: int,
+                    cache: Optional[_SegCache]) -> "_ColdSeg":
+        """A quarantined manifest entry reopened after a restart: the
+        slot keeps the tier layout contiguous, every load is a typed
+        refusal, and the empty add index simply fails to resolve
+        marks in the covered range (``found=0`` → the puller re-pulls
+        from an earlier mark — correct, just slower)."""
+        seg = _ColdSeg(path, start, length,
+                       np.zeros(0, np.int64), np.zeros(0, np.int32),
+                       0, cache, False)
+        seg.quarantined = True
+        seg.index_ok = False
+        return seg
 
     @staticmethod
     def _add_index(kind: np.ndarray, ts: np.ndarray
@@ -508,7 +534,14 @@ class _ColdSeg:
         """The segment's full columns (LRU-cached).  Raises
         :class:`CheckpointError` when the file is missing or corrupt —
         a collected-but-still-needed segment must fail loudly, never
-        serve a silent partial log."""
+        serve a silent partial log — and when the segment is
+        QUARANTINED (scrub found bit-rot; peer repair pending): the
+        corrupt bytes are never served, not even by a read that races
+        the repair."""
+        if self.quarantined:
+            raise CheckpointError(
+                f"op-log segment {self.path!r} is quarantined "
+                f"(checksum scrub failed; repair pending)")
         def _loader() -> PackedOps:
             p, _ = packed_mod.load_packed_npz(self.path)
             if p.num_ops != self.length:
@@ -876,6 +909,9 @@ class OpLog:
         self.compactions = 0
         self.segments_gc = 0
         self.gc_deferred = 0
+        # scrub-with-peer-repair counters (crdt_scrub_* families)
+        self.quarantines = 0
+        self.repairs = 0
         ops = list(ops)
         if ops:
             self.extend(ops)
@@ -1414,10 +1450,14 @@ class OpLog:
             # base_chunks and ignore it
             "base": None,
             "base_chunks": [{"file": os.path.basename(cs.path),
-                             "start": cs.start, "len": cs.length}
+                             "start": cs.start, "len": cs.length,
+                             **({"quarantined": True}
+                                if cs.quarantined else {})}
                             for cs in self._bases],
             "segments": [{"file": os.path.basename(cs.path),
-                          "start": cs.start, "len": cs.length}
+                          "start": cs.start, "len": cs.length,
+                          **({"quarantined": True}
+                             if cs.quarantined else {})}
                          for cs in self._cold],
             "matz": dict(self._matz) if self._matz is not None
             else None,
@@ -1568,6 +1608,11 @@ class OpLog:
         stable = self._stable_locked()
         fold: List[_ColdSeg] = []
         for cs in self._cold:
+            if cs.quarantined:
+                # an unreadable (bit-rotted, repair-pending) segment
+                # cannot fold; everything after it waits too — the
+                # base must stay a readable contiguous prefix
+                break
             if cs.start + cs.length <= stable:
                 fold.append(cs)
             else:
@@ -1582,7 +1627,8 @@ class OpLog:
         parts: List[PackedOps] = []
         new_bases = list(self._bases)
         rewritten: List[_ColdSeg] = []
-        if new_bases and new_bases[-1].length < chunk_ops:
+        if new_bases and new_bases[-1].length < chunk_ops \
+                and not new_bases[-1].quarantined:
             # merge the trailing partial chunk with the fold input so
             # chunks stay densely packed (bounded catch-up reads)
             tail = new_bases.pop()
@@ -1637,6 +1683,174 @@ class OpLog:
                 pass
         self._tombs = keep
         self.gc_deferred = len(keep)
+
+    # -- scrub & quarantine (docs/DURABILITY.md §Scrub & repair) ----------
+
+    def scrub(self) -> Dict[str, Any]:
+        """Re-verify the checksums of every cold segment, base chunk,
+        and the matz artifact (the bit-rot sweep the maintenance
+        worker runs on a cadence).  A corrupt TIER file is quarantined
+        — its descriptor refuses every load and the manifest is
+        atomically rewritten so a restart inherits the quarantine —
+        and left for :meth:`repair_segment` to heal from a fleet peer.
+        A corrupt MATZ artifact is simply dropped from the manifest:
+        it is derived data, and the next cadence refresh regenerates
+        it (the single-node "warned fallback" taxonomy).  File reads
+        run OUTSIDE the tier lock; quarantine decisions re-check the
+        descriptor under it."""
+        report: Dict[str, Any] = {
+            "checked": 0, "ok": 0, "corrupt": 0,
+            "matz_dropped": 0, "quarantined": 0, "reasons": []}
+        cfg = self._cfg
+        if cfg is None:
+            return report
+        with self._mu:
+            targets = [s for s in self._bases + self._cold
+                       if not s.quarantined]
+            matz = dict(self._matz) if self._matz is not None else None
+        corrupt: List[Tuple[_ColdSeg, str]] = []
+        for seg in targets:
+            report["checked"] += 1
+            reason = packed_mod.verify_packed_npz(
+                seg.path, expect_ops=seg.length)
+            if reason is None:
+                report["ok"] += 1
+            else:
+                corrupt.append((seg, reason))
+                report["reasons"].append(
+                    f"{os.path.basename(seg.path)}: {reason}")
+        matz_bad: Optional[str] = None
+        if matz is not None:
+            report["checked"] += 1
+            matz_bad = packed_mod.verify_packed_npz(
+                os.path.join(cfg.dir, matz["file"]))
+            if matz_bad is None:
+                report["ok"] += 1
+            else:
+                report["reasons"].append(
+                    f"{matz['file']}: {matz_bad}")
+        if corrupt or matz_bad is not None:
+            with self._mu:
+                changed = False
+                live = set(map(id, self._bases + self._cold))
+                for seg, _reason in corrupt:
+                    if seg.quarantined or id(seg) not in live:
+                        # a concurrent fold/GC legitimately rewrote or
+                        # deleted the file the lock-free verify read —
+                        # a retired descriptor is not bit-rot
+                        continue
+                    seg.quarantined = True
+                    self.quarantines += 1
+                    report["corrupt"] += 1
+                    if self._cache is not None:
+                        # a cached copy predates the corruption, but a
+                        # quarantined range must have ONE truth: the
+                        # typed refusal until repair lands
+                        self._cache.drop(seg.path)
+                    changed = True
+                if matz_bad is not None and self._matz is not None \
+                        and self._matz["file"] == matz["file"]:
+                    self._drop_matz_locked()
+                    report["matz_dropped"] = 1
+                    changed = True
+                if changed:
+                    self._durable_manifest_locked()
+            self._fire_advance()
+        with self._mu:
+            report["quarantined"] = sum(
+                1 for s in self._bases + self._cold if s.quarantined)
+        return report
+
+    def quarantined_segments(self) -> List[_ColdSeg]:
+        """Live quarantined descriptors (this scrub's finds plus any
+        inherited from a restart) — the repair loop's work list."""
+        with self._mu:
+            return [s for s in self._bases + self._cold
+                    if s.quarantined]
+
+    def repair_spec(self, seg: _ColdSeg) -> Optional[Dict[str, int]]:
+        """The peer-fetch entry point for a quarantined segment's row
+        range: ``since`` = the last Add timestamp strictly BEFORE the
+        range (resolved from the neighboring tiers' resident add
+        indexes — no disk touch), ``p0`` its global position; 0/0 when
+        no prior Add resolves (the fetch then chains from the log's
+        first window — more rows, same answer)."""
+        with self._mu:
+            if not seg.quarantined:
+                return None
+            since = p0 = 0
+            prior = [s for s in self._bases + self._cold
+                     if s.start < seg.start]
+            for other in reversed(prior):
+                if other.quarantined or other.n_adds == 0:
+                    continue
+                i = int(np.argmax(other.add_pos))
+                since = int(other.add_ts[i])
+                p0 = other.start + int(other.add_pos[i])
+                break
+            return {"start": seg.start,
+                    "stop": seg.start + seg.length,
+                    "since": since, "p0": p0}
+
+    def repair_segment(self, seg: _ColdSeg, p: PackedOps) -> bool:
+        """Heal a quarantined segment with rows re-fetched from a
+        fleet peer: cross-check them against the descriptor's resident
+        add index (built from the file when it was still healthy —
+        a diverged peer's rows are REFUSED, the quarantine stands),
+        seal a fresh file, swap the descriptor's backing in place
+        (every pinned view heals with it — the rows are identical by
+        construction), and atomically rewrite the manifest.  The
+        corrupt file is deleted only after the manifest stopped
+        referencing anything at its path."""
+        with self._mu:
+            cfg = self._cfg
+            if cfg is None or not seg.quarantined:
+                return False
+            n = p.num_ops
+            if n != seg.length:
+                return False
+            add_ts, add_pos = _ColdSeg._add_index(p.kind[:n],
+                                                  p.ts[:n])
+            if seg.index_ok and (
+                    not np.array_equal(add_ts, seg.add_ts)
+                    or not np.array_equal(add_pos, seg.add_pos)):
+                return False
+            self._file_seq += 1
+            path = os.path.join(
+                cfg.dir, f"seg-{seg.start:012d}-{seg.length}-"
+                         f"{self._file_seq}.npz")
+        # the O(chunk) serialize + fsync runs OUTSIDE the tier lock —
+        # a repair must never stall the doc's commit/read paths for a
+        # whole disk write (the maintenance-lane rule)
+        fresh = _ColdSeg.seal(p, seg.start, path, self._cache,
+                              fsync=cfg.durable)
+        with self._mu:
+            if not seg.quarantined:
+                # raced another repair of the same slot: ours loses
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return False
+            old_path = seg.path
+            seg.add_ts, seg.add_pos = fresh.add_ts, fresh.add_pos
+            seg.file_bytes = fresh.file_bytes
+            seg.hints_vouched = fresh.hints_vouched
+            seg.index_ok = True
+            # path before the flag: a racing reader that sees the
+            # quarantine lifted must already be pointed at the fresh
+            # file, never the corrupt one
+            seg.path = fresh.path
+            seg.quarantined = False
+            self.repairs += 1
+            self._durable_manifest_locked()
+            if old_path != path:
+                try:
+                    os.remove(old_path)
+                except OSError:
+                    pass
+        self._fire_advance()
+        return True
 
     # -- views ------------------------------------------------------------
 
@@ -1877,9 +2091,13 @@ class OpLog:
                         f"op-log manifest {path!r}: base chunk "
                         f"{e['file']!r} starts at {e['start']}, "
                         f"expected {running}")
-                log._bases.append(_ColdSeg.open(
-                    os.path.join(dir, e["file"]), e["start"],
-                    e["len"], log._cache))
+                fp = os.path.join(dir, e["file"])
+                log._bases.append(
+                    _ColdSeg.placeholder(fp, e["start"], e["len"],
+                                         log._cache)
+                    if e.get("quarantined") else
+                    _ColdSeg.open(fp, e["start"], e["len"],
+                                  log._cache))
                 running += e["len"]
             for e in seg_es:
                 if e["start"] != running:
@@ -1887,9 +2105,13 @@ class OpLog:
                         f"op-log manifest {path!r}: segment "
                         f"{e['file']!r} starts at {e['start']}, "
                         f"expected {running}")
-                log._cold.append(_ColdSeg.open(
-                    os.path.join(dir, e["file"]), e["start"],
-                    e["len"], log._cache))
+                fp = os.path.join(dir, e["file"])
+                log._cold.append(
+                    _ColdSeg.placeholder(fp, e["start"], e["len"],
+                                         log._cache)
+                    if e.get("quarantined") else
+                    _ColdSeg.open(fp, e["start"], e["len"],
+                                  log._cache))
                 running += e["len"]
             log._matz = dict(matz_e) if matz_e is not None else None
             if running != length:
@@ -1995,6 +2217,12 @@ class OpLog:
                 "compactions": self.compactions,
                 "segments_gc": self.segments_gc,
                 "gc_deferred": self.gc_deferred,
+                # scrub & quarantine (docs/DURABILITY.md §Scrub)
+                "quarantines": self.quarantines,
+                "repairs": self.repairs,
+                "quarantined": sum(
+                    1 for s in self._bases + self._cold
+                    if s.quarantined),
                 "segment_loads": loads,
                 "cache_evictions": evictions,
                 "load_ms": self._cache.hist_export()

@@ -148,6 +148,13 @@ class ServedDoc:
                     ephemeral=True, cache=engine.oplog_cache)
         self.queue = DocQueue(max_requests=engine.max_queue_requests,
                               max_leaves=engine.max_queue_leaves)
+        # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
+        # the maintenance lane's cadence sweep re-verifies cold-file
+        # checksums and heals quarantined ranges from fleet peers
+        self.scrub_stats: Dict[str, int] = {
+            "runs": 0, "checked": 0, "corrupt": 0, "repaired": 0,
+            "repair_failed": 0, "matz_dropped": 0}
+        self._last_scrub = time.monotonic()
         self.next_replica = 1
         self._replica_lock = threading.Lock()
         # CRDT counters (parity with service.store.Document)
@@ -297,6 +304,43 @@ class ServedDoc:
         # prefix the new manifest covers drops at the next barrier
         # (the usual deferred-truncation rule)
         self.tree.write_matz()
+
+    def run_scrub(self) -> Dict:
+        """One scrub pass (maintenance-lane thread): checksum sweep of
+        every cold segment, base chunk, and the matz artifact; corrupt
+        tier files quarantine (typed refusals until healed) and, when
+        the engine has a fleet ``repair_fetcher`` (cluster/gateway.py),
+        each quarantined range is re-fetched from a peer through the
+        ordinary window machinery and re-sealed in place.  Pure numpy
+        + file + HTTP I/O — no JAX, maintenance-lane safe."""
+        log = self.tree._log
+        if not log.tiering_enabled:
+            return {}
+        report = log.scrub()
+        st = self.scrub_stats
+        st["runs"] += 1
+        st["checked"] += report.get("checked", 0)
+        st["corrupt"] += report.get("corrupt", 0)
+        st["matz_dropped"] += report.get("matz_dropped", 0)
+        fetcher = self._engine.repair_fetcher
+        for seg in log.quarantined_segments():
+            if fetcher is None:
+                # single node: nothing to heal from — the quarantine
+                # stands as a typed error on touch (never wrong
+                # data).  NOT counted as a failed repair: no attempt
+                # was made, and the standing condition is already the
+                # quarantined gauge — repair_failed must keep meaning
+                # "a peer fetch was tried and didn't work"
+                continue
+            spec = log.repair_spec(seg)
+            if spec is None:
+                continue            # raced a concurrent repair
+            rows = fetcher(self.doc_id, spec)
+            if rows is not None and log.repair_segment(seg, rows):
+                st["repaired"] += 1
+            else:
+                st["repair_failed"] += 1
+        return report
 
     # -- snapshot publication (scheduler thread only) ---------------------
 
@@ -459,6 +503,10 @@ class ServedDoc:
             "matz": dict(self.tree.matz_stats,
                          len=oplog_tele["matz_len"])
             if self._engine.durable_dir is not None else None,
+            # scrub & repair (docs/DURABILITY.md §Scrub & repair)
+            "scrub": dict(self.scrub_stats,
+                          quarantined=oplog_tele.get("quarantined", 0))
+            if self.tree._log.tiering_enabled else None,
         }
 
 
@@ -589,6 +637,14 @@ class ServingEngine:
             pipeline = os.environ.get(
                 "GRAFT_PIPELINE", "1").strip() not in ("", "0")
         self.pipeline = bool(pipeline)
+        # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
+        # the maintenance worker sweeps each tiered doc's cold files
+        # on this cadence (0 = off; the fleet __main__ arms it);
+        # repair_fetcher is installed by a ClusterNode — single-node
+        # engines quarantine without healing (typed error on touch)
+        self.scrub_interval_s = _env_float("GRAFT_SCRUB_INTERVAL_S",
+                                           0.0)
+        self.repair_fetcher = None
         # size/age spill-policy knobs (maintenance worker policy tick)
         self.oplog_hot_age_s = _env_float("GRAFT_OPLOG_HOT_AGE_S", 0.0)
         self.oplog_resident_bytes = _env_int(

@@ -350,9 +350,12 @@ class WalSyncWorker(threading.Thread):
 class MaintenanceWorker(threading.Thread):
     """The tier-maintenance lane (module docstring): a bounded FIFO of
     ``(kind, doc, payload)`` tasks — ``spill`` (which runs fold/GC +
-    tomb sweeping behind the seal) / ``compact`` / ``matz`` — plus a
-    periodic policy tick implementing the age and engine-wide
-    resident-bytes spill policies."""
+    tomb sweeping behind the seal) / ``compact`` / ``matz`` /
+    ``scrub`` (the checksum sweep + peer repair of
+    docs/DURABILITY.md §Scrub & repair, on the
+    ``GRAFT_SCRUB_INTERVAL_S`` cadence) — plus a periodic policy tick
+    implementing the age and engine-wide resident-bytes spill
+    policies."""
 
     POLL_S = 0.5
 
@@ -373,6 +376,7 @@ class MaintenanceWorker(threading.Thread):
         self.inline_spill_fallbacks = 0
         self.policy_age_spills = 0
         self.policy_resident_spills = 0
+        self.scrubs_queued = 0
         self.task_ms = Histogram(LATENCY_BOUNDS_MS)
         self.matz_export_ms = Histogram(LATENCY_BOUNDS_MS)
 
@@ -444,6 +448,7 @@ class MaintenanceWorker(threading.Thread):
                 "inline_spill_fallbacks": self.inline_spill_fallbacks,
                 "policy_age_spills": self.policy_age_spills,
                 "policy_resident_spills": self.policy_resident_spills,
+                "scrubs_queued": self.scrubs_queued,
                 "task_ms": self.task_ms.export(),
                 "matz_export_ms": self.matz_export_ms.export(),
                 "crashed": self.crashed}
@@ -526,6 +531,10 @@ class MaintenanceWorker(threading.Thread):
             finally:
                 self.matz_export_ms.observe(
                     (time.perf_counter() - t0) * 1e3)
+        elif kind == "scrub":
+            # checksum sweep + quarantine + peer repair — numpy/file/
+            # HTTP I/O only, same no-JAX lane contract as the rest
+            doc.run_scrub()
 
     # -- spill policies (ISSUE 12 satellite) -------------------------------
 
@@ -533,8 +542,11 @@ class MaintenanceWorker(threading.Thread):
         """Size/age spill policy for many-doc fleets: sweep hot tails
         past ``GRAFT_OPLOG_HOT_AGE_S``, and when the engine-wide
         hot-resident total exceeds ``GRAFT_OPLOG_RESIDENT_MB``, drain
-        the LARGEST hot tails first until the projection fits."""
+        the LARGEST hot tails first until the projection fits.  Also
+        queues each tiered doc's checksum scrub on the
+        ``GRAFT_SCRUB_INTERVAL_S`` cadence."""
         eng = self.engine
+        self._scrub_tick()
         age = eng.oplog_hot_age_s
         budget = eng.oplog_resident_bytes
         if age <= 0 and budget <= 0:
@@ -561,3 +573,20 @@ class MaintenanceWorker(threading.Thread):
                 if self.enqueue("spill", d, {"keep_hot": 0}):
                     self.policy_resident_spills += 1
                     total -= b
+
+    def _scrub_tick(self) -> None:
+        """Queue a scrub for every tiered doc whose last sweep is
+        older than the cadence (docs/DURABILITY.md §Scrub & repair).
+        The stamp advances at ENQUEUE so a slow sweep never stacks
+        duplicates behind itself (enqueue coalesces anyway)."""
+        interval = self.engine.scrub_interval_s
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        for d in self.engine.docs():
+            if not d.tree._log.tiering_enabled:
+                continue
+            if now - d._last_scrub >= interval:
+                if self.enqueue("scrub", d):
+                    d._last_scrub = now
+                    self.scrubs_queued += 1

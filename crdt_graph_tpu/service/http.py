@@ -75,10 +75,13 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..codec.json_codec import DecodeError
+from ..core.errors import CheckpointError
 from ..obs import prom as prom_mod
-from ..obs.trace import (AE_PEER_HEADER, CATCHUP_REMAINING_HEADER,
+from ..obs.trace import (AE_LAG_HEADER, AE_PEER_HEADER,
+                         CATCHUP_REMAINING_HEADER,
                          COMMIT_SEQ_HEADER,
-                         FORWARDED_HEADER, SESSION_HEADER,
+                         FORWARDED_HEADER, MAX_STALENESS_HEADER,
+                         SESSION_HEADER,
                          SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
                          SINCE_NEXT_HEADER, SNAP_FP_HEADER,
                          TRACE_HEADER, ensure_session_id,
@@ -142,13 +145,15 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
         def _body(self, n: int) -> bytes:
             return self.rfile.read(n)
 
-        def _read_trace_headers(self, snap) -> dict:
+        def _read_trace_headers(self, snap, ae_lag_hdr=None) -> dict:
             """Read-path correlation headers (obs/trace.py): the served
             snapshot's identity plus the session id (adopted from a
             well-formed ``X-Session-Id``, minted otherwise).  A fleet
             store (cluster/gateway.py) additionally stamps the replica
             identity + replica-independent state fingerprint, so a
-            replica-local read's staleness is wire-observable."""
+            replica-local read's staleness is wire-observable
+            (``ae_lag_hdr`` carries the staleness gate's own lag
+            sample so it is computed once per request)."""
             out = {
                 SNAP_FP_HEADER: snap.fingerprint(),
                 COMMIT_SEQ_HEADER: str(snap.seq),
@@ -156,7 +161,8 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     self.headers.get(SESSION_HEADER)),
             }
             if hasattr(store, "extra_read_headers"):
-                out.update(store.extra_read_headers(snap))
+                out.update(store.extra_read_headers(
+                    snap, ae_lag_hdr=ae_lag_hdr))
             return out
 
         def do_GET(self):
@@ -211,6 +217,37 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     return
                 self._send(404, {"error": f"no document {doc_id}"})
                 return
+            ae_lag_hdr = None
+            if sub in ("", "/snapshot") and \
+                    hasattr(store, "check_staleness"):
+                # bounded-staleness read contract (docs/CLUSTER.md
+                # §Partitions & staleness): a read bounded by
+                # X-Max-Staleness (or the server's
+                # GRAFT_MAX_STALENESS_S default) on a replica whose
+                # anti-entropy lag exceeds the bound gets an honest
+                # 503 + Retry-After instead of silently stale data —
+                # a partitioned replica degrades, it does not lie.
+                # The gate's lag sample also feeds the served read's
+                # X-Ae-Lag-Seconds stamp (one sample per request —
+                # gate and stamp can never disagree)
+                stale, ae_lag_hdr = store.check_staleness(
+                    self.headers.get(MAX_STALENESS_HEADER))
+                if stale is not None:
+                    # lag_s is None when unbounded (a replica that has
+                    # never fully synced) — Infinity is not valid JSON
+                    lag_txt = "unbounded" if stale["lag_s"] is None \
+                        else f"{stale['lag_s']}s"
+                    self._send(
+                        503,
+                        {"error": f"replica staleness {lag_txt} "
+                                  f"exceeds the {stale['bound_s']}s "
+                                  "bound",
+                         "ae_lag_s": stale["lag_s"],
+                         "retry_after_s": stale["retry_after_s"]},
+                        headers={
+                            "Retry-After": str(stale["retry_after_s"]),
+                            AE_LAG_HEADER: ae_lag_hdr})
+                    return
             if sub == "":
                 if hasattr(doc, "read_view"):
                     # body and headers come from the SAME snapshot: a
@@ -218,7 +255,8 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     # values body must never straddle a publish
                     snap = doc.read_view()
                     self._send(200, {"values": snap.visible_values()},
-                               headers=self._read_trace_headers(snap))
+                               headers=self._read_trace_headers(
+                                   snap, ae_lag_hdr=ae_lag_hdr))
                 else:       # legacy DocumentStore: no snapshot identity
                     self._send(200, {"values": doc.snapshot()})
             elif sub == "/ops":
@@ -243,26 +281,50 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 # bounded + resumable and its state rides the
                 # X-Since-* headers — the body stays a plain wire
                 # batch either way (engine.packed_since_window)
-                if limit > 0 and hasattr(doc, "ops_since_window"):
-                    body, meta = doc.ops_since_window(since, limit)
-                    self._send_raw(200, body, headers={
-                        SINCE_FOUND_HEADER:
-                            "1" if meta["found"] else "0",
-                        SINCE_MORE_HEADER: "1" if meta["more"] else "0",
-                        **({SINCE_NEXT_HEADER: str(meta["next_since"])}
-                           if meta["next_since"] is not None else {}),
-                    })
-                else:
-                    self._send_raw(200, doc.dumps_since_bytes(since))
+                try:
+                    if limit > 0 and hasattr(doc, "ops_since_window"):
+                        body, meta = doc.ops_since_window(since, limit)
+                        self._send_raw(200, body, headers={
+                            SINCE_FOUND_HEADER:
+                                "1" if meta["found"] else "0",
+                            SINCE_MORE_HEADER:
+                                "1" if meta["more"] else "0",
+                            **({SINCE_NEXT_HEADER:
+                                str(meta["next_since"])}
+                               if meta["next_since"] is not None
+                               else {}),
+                        })
+                    else:
+                        self._send_raw(200,
+                                       doc.dumps_since_bytes(since))
+                except CheckpointError as e:
+                    # a window that touches a quarantined (bit-rotted)
+                    # tier file: typed refusal + Retry-After — the
+                    # scrub repair path is healing the range; corrupt
+                    # bytes are NEVER served (docs/DURABILITY.md
+                    # §Scrub & repair)
+                    self._send(503, {"error": str(e),
+                                     "retry_after_s": 5},
+                               headers={"Retry-After": "5"})
             elif sub == "/snapshot":
-                if hasattr(doc, "read_view"):
-                    snap = doc.read_view()
-                    self._send_raw(200, snap.checkpoint_bytes(),
-                                   ctype="application/octet-stream",
-                                   headers=self._read_trace_headers(snap))
-                else:
-                    self._send_raw(200, doc.snapshot_packed(),
-                                   ctype="application/octet-stream")
+                try:
+                    if hasattr(doc, "read_view"):
+                        snap = doc.read_view()
+                        self._send_raw(
+                            200, snap.checkpoint_bytes(),
+                            ctype="application/octet-stream",
+                            headers=self._read_trace_headers(
+                                snap, ae_lag_hdr=ae_lag_hdr))
+                    else:
+                        self._send_raw(200, doc.snapshot_packed(),
+                                       ctype="application/octet-stream")
+                except CheckpointError as e:
+                    # same quarantine rule as /ops: a checkpoint
+                    # reassembly that needs a quarantined file refuses
+                    # honestly instead of serving corrupt bytes
+                    self._send(503, {"error": str(e),
+                                     "retry_after_s": 5},
+                               headers={"Retry-After": "5"})
             elif sub == "/clock":
                 self._send(200, {"replicas": doc.clock()})
             elif sub == "/metrics":

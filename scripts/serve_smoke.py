@@ -47,7 +47,7 @@ jax.config.update("jax_enable_x64", True)
 
 def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
         delta_size: int = 12) -> dict:
-    from http.client import HTTPConnection
+    from http.client import HTTPConnection, RemoteDisconnected
 
     from crdt_graph_tpu import engine as engine_mod
     from crdt_graph_tpu.codec import json_codec
@@ -59,12 +59,30 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     port = srv.server_port
 
     def req_full(method, path, body=None, headers=None):
-        conn = HTTPConnection("127.0.0.1", port, timeout=60)
-        conn.request(method, path, body=body, headers=headers or {})
-        resp = conn.getresponse()
-        raw = resp.read()
-        conn.close()
-        return resp.status, raw, resp
+        # one retry on a transient transport reset: the smoke opens a
+        # fresh connection per request from ~16 unthrottled threads,
+        # and that loopback churn occasionally lands a connect on a
+        # TIME_WAIT 4-tuple the kernel answers with RST — a transport
+        # artifact, not a serving property.  Retrying POST /ops is
+        # safe by construction: timestamps are writer-unique, so a
+        # delta that DID land before the reset dup-absorbs on replay
+        # (applied_count 0 — the writer accepts either count).
+        for attempt in (0, 1):
+            conn = HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                raw = resp.read()
+                resp.retried = bool(attempt)
+                return resp.status, raw, resp
+            except (ConnectionResetError, ConnectionAbortedError,
+                    BrokenPipeError, RemoteDisconnected):
+                if attempt:
+                    raise
+                time.sleep(0.05)
+            finally:
+                conn.close()
 
     def req(method, path, body=None, headers=None):
         st, raw, _ = req_full(method, path, body=body, headers=headers)
@@ -102,14 +120,19 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
             tid = f"smoke-{doc_id}-r{rid}-{di:02d}"
             with trace_lock:
                 pushed_trace_ids.add(tid)
-            st, raw = req("POST", f"/docs/{doc_id}/ops",
-                          json_codec.dumps(Batch(tuple(ops))),
-                          headers={"X-Trace-Id": tid,
-                                   "X-Session-Id": sess})
+            st, raw, resp = req_full(
+                "POST", f"/docs/{doc_id}/ops",
+                json_codec.dumps(Batch(tuple(ops))),
+                headers={"X-Trace-Id": tid, "X-Session-Id": sess})
             out = json.loads(raw)
+            # applied_count 0 is legal ONLY when the transport retry
+            # replayed a delta that already landed (timestamps are
+            # writer-unique, so the dup absorbs); on a first attempt
+            # any count but delta_size is a real loss
+            count_ok = out.get("applied_count") == delta_size \
+                or (resp.retried and out.get("applied_count") == 0)
             if st != 200 or not out.get("accepted") \
-                    or out.get("applied_count") != delta_size \
-                    or out.get("trace_id") != tid:
+                    or not count_ok or out.get("trace_id") != tid:
                 errors.append(f"push {st}: {out}")
                 return
         # read-your-writes over the wire (ISSUE 6): every delta above

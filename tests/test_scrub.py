@@ -1,0 +1,434 @@
+"""Partition & bit-rot chaos, disk half (ISSUE 14): the checksum
+scrub on the maintenance lane, quarantine-on-corruption (atomic
+manifest rewrite, typed refusals — never silent wrong data), and
+scrub-with-peer-repair over the ordinary ``packed_since_window``
+machinery, converging fingerprint-equal.
+
+Corruption taxonomy pinned here (satellite): an injected crc flip in a
+cold segment, a base chunk, and a matz artifact must each surface as
+quarantine + repair (fleet) or typed error + warned fallback (single
+node) — reads never observe the corrupt bytes either way.
+"""
+import glob
+import json
+import os
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.cluster import FleetServer, MemoryKV
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.codec import packed as packed_mod
+from crdt_graph_tpu.core.errors import CheckpointError
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.obs import flight as flight_mod
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.serve import ServingEngine
+
+
+def ts(r, c):
+    return r * 2**32 + c
+
+
+def _chain(rid, n, start=1, prev=0):
+    ops = []
+    for c in range(start, start + n):
+        ops.append(Add(ts(rid, c), (prev,), f"r{rid}:{c}"))
+        prev = ts(rid, c)
+    return json_codec.dumps(Batch(tuple(ops)))
+
+
+def _flip_byte(path):
+    """One bit-rot event: flip a byte inside the DATA of the largest
+    zip member (a flip in a zip local header is benign — zipfile reads
+    sizes from the central directory — and legitimately not flagged;
+    a data flip must always fail the member CRC)."""
+    import struct
+    import zipfile
+    with zipfile.ZipFile(path) as z:
+        info = max(z.infolist(), key=lambda i: i.compress_size)
+    with open(path, "r+b") as f:
+        f.seek(info.header_offset + 26)
+        fn_len, extra_len = struct.unpack("<HH", f.read(4))
+        off = (info.header_offset + 30 + fn_len + extra_len
+               + info.compress_size // 2)
+        f.seek(off)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def req(port, method, path, body=None, headers=None, timeout=120):
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, raw, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _window_chain(port, doc, limit=50):
+    out, since = [], 0
+    for _ in range(1000):
+        st, raw, hdr = req(port, "GET",
+                           f"/docs/{doc}/ops?since={since}"
+                           f"&limit={limit}")
+        assert st == 200, (st, raw[:200])
+        out.append(raw)
+        if hdr.get("X-Since-More") != "1":
+            return out
+        since = int(hdr["X-Since-Next"])
+    pytest.fail("window chain never terminated")
+
+
+# -- verify_packed_npz (the scrub's checksum primitive) ----------------------
+
+
+def test_verify_packed_npz_catches_flips(tmp_path):
+    from crdt_graph_tpu import engine as engine_mod
+    p = packed_mod.pack([Add(ts(1, c), (0,), c) for c in range(1, 9)])
+    path = str(tmp_path / "seg.npz")
+    engine_mod.write_packed_npz(path, p, {"num_ops": p.num_ops})
+    assert packed_mod.verify_packed_npz(path) is None
+    assert packed_mod.verify_packed_npz(
+        path, expect_ops=p.num_ops) is None
+    assert packed_mod.verify_packed_npz(path, expect_ops=99)
+    _flip_byte(path)
+    assert packed_mod.verify_packed_npz(path) is not None
+    assert packed_mod.verify_packed_npz(
+        str(tmp_path / "missing.npz")) is not None
+
+
+# -- single node: quarantine + typed errors + warned matz fallback -----------
+
+
+@pytest.fixture
+def _small_tiers(monkeypatch):
+    monkeypatch.setenv("GRAFT_OPLOG_GC_SEGS", "2")
+    monkeypatch.setenv("GRAFT_MATZ_TAIL_OPS", "64")
+
+
+def _fill_doc(eng, doc_id, rid, n_chains=6, per=100):
+    prev = 0
+    for k in range(n_chains):
+        body = _chain(rid, per, start=k * per + 1, prev=prev)
+        prev = ts(rid, (k + 1) * per)
+        accepted, _ = eng.submit(doc_id, body)
+        assert accepted
+    assert eng.flush(timeout=120)
+
+
+def test_single_node_corruption_taxonomy(tmp_path, _small_tiers):
+    ddir = str(tmp_path / "srv")
+    eng = ServingEngine(durable_dir=ddir, oplog_hot_ops=64,
+                        flight=flight_mod.FlightRecorder())
+    try:
+        _fill_doc(eng, "tax", 3)
+        doc = eng.get("tax")
+        docdir = os.path.join(ddir, "doc-tax")
+        # flip LIVE tier files (glob would also match folded tombs a
+        # pinned view is still deferring — those are not scrubbed)
+        log = doc.tree._log
+        segs = [s.path for s in log._cold]
+        bases = [s.path for s in log._bases]
+        entry = log.matz_entry
+        matz = [os.path.join(docdir, entry["file"])] if entry else []
+        assert segs and bases and matz, (segs, bases, matz)
+
+        # a clean scrub finds nothing
+        doc.run_scrub()
+        assert doc.scrub_stats["corrupt"] == 0
+        assert doc.scrub_stats["checked"] > 0
+
+        # flip one cold segment, one base chunk, and the matz artifact
+        _flip_byte(segs[-1])
+        _flip_byte(bases[0])
+        _flip_byte(matz[-1])
+        doc.run_scrub()
+        st = doc.scrub_stats
+        assert st["corrupt"] == 2            # the two TIER files
+        assert st["matz_dropped"] == 1       # matz: dropped, re-derived
+        # single node: no peer to heal from — quarantine stands; no
+        # repair was ATTEMPTED, so repair_failed stays 0 (the standing
+        # condition is the quarantined gauge, not a failure counter)
+        assert st["repaired"] == 0 and st["repair_failed"] == 0
+        tele = doc.tree._log.telemetry()
+        assert tele["quarantined"] == 2
+        assert tele["quarantines"] == 2
+        assert doc.tree._log.matz_entry is None
+
+        # typed refusal on touch: a window over the quarantined range
+        # raises CheckpointError — never the corrupt bytes
+        view = doc.tree._log.view()
+        with pytest.raises(CheckpointError, match="quarantined"):
+            view.window(0, 50)
+        # published-snapshot reads (values) keep serving
+        assert len(doc.snapshot()) == 600
+        # and the LIVE node still ACKS writes (its mirror is resident;
+        # only a restart would need the quarantined rows)
+        accepted, _ = eng.submit("tax", _chain(
+            3, 5, start=601, prev=ts(3, 600)))
+        assert accepted
+        # the scrub is idempotent: already-quarantined files are not
+        # re-counted
+        doc.run_scrub()
+        assert doc.scrub_stats["corrupt"] == 2
+    finally:
+        eng.close()
+
+
+def test_quarantine_survives_restart_manifest_roundtrip(
+        tmp_path, _small_tiers):
+    ddir = str(tmp_path / "srv")
+    eng = ServingEngine(durable_dir=ddir, oplog_hot_ops=64,
+                        flight=flight_mod.FlightRecorder())
+    _fill_doc(eng, "rb", 4)
+    docdir = os.path.join(ddir, "doc-rb")
+    # corrupt the EARLIEST live tier file (inside matz coverage) and
+    # scrub so the quarantine lands in the manifest
+    doc = eng.get("rb")
+    live = doc.tree._log._bases + doc.tree._log._cold
+    _flip_byte(live[0].path)
+    doc.run_scrub()
+    assert doc.tree._log.telemetry()["quarantined"] == 1
+    manifest = json.load(open(os.path.join(docdir, "manifest.json")))
+    assert any(e.get("quarantined")
+               for e in manifest["base_chunks"] + manifest["segments"])
+    eng.close()
+
+    # restart: recovery inherits the quarantine instead of bricking —
+    # the matz artifact covers state materialization, values serve,
+    # and the hole stays a typed refusal until a peer repairs it
+    eng2 = ServingEngine(durable_dir=ddir, oplog_hot_ops=64,
+                         flight=flight_mod.FlightRecorder())
+    try:
+        doc2 = eng2.get("rb")
+        assert doc2 is not None and doc2.recovered
+        tele = doc2.tree._log.telemetry()
+        assert tele["quarantined"] == 1
+        assert len(doc2.snapshot()) == 600   # matz-backed state
+        with pytest.raises(CheckpointError, match="quarantined"):
+            doc2.tree._log.view().window(0, 50)
+        # scrub on the restored node: still no peer — stands
+        doc2.run_scrub()
+        assert doc2.tree._log.telemetry()["quarantined"] == 1
+        assert doc2.scrub_stats["repair_failed"] == 0
+    finally:
+        eng2.close()
+
+
+# -- fleet: scrub-with-peer-repair -------------------------------------------
+
+
+def _spawn_durable_fleet(tmp_path, names, **node_kw):
+    kv = MemoryKV()
+    fleet = {}
+    for n in names:
+        eng = ServingEngine(
+            durable_dir=os.path.join(str(tmp_path), n),
+            oplog_hot_ops=64, flight=flight_mod.FlightRecorder())
+        fleet[n] = FleetServer(n, kv, engine=eng, ttl_s=600.0,
+                               ae_interval_s=3600.0, **node_kw)
+    for fs in fleet.values():
+        fs.node.refresh_ring()
+    return fleet
+
+
+def _stop_fleet(fleet):
+    for fs in fleet.values():
+        try:
+            fs.stop()
+        except Exception:  # noqa: BLE001 — teardown boundary
+            pass
+
+
+def _doc_owned_by(ring, owner, prefix="doc"):
+    for i in range(500):
+        d = f"{prefix}{i}"
+        if ring.primary(d) == owner:
+            return d
+    pytest.fail(f"no doc routed to {owner}")
+
+
+def test_fleet_scrub_repairs_from_peer_windows_byte_identical(
+        tmp_path, _small_tiers):
+    """The acceptance scenario: a corrupt cold file on one replica is
+    detected by scrub, quarantined, re-fetched from a peer through the
+    ordinary window machinery, and the doc's full window chain stays
+    byte-identical to the uncorrupted replica — reads that touch the
+    hole meanwhile get typed 503s, never the corrupt bytes."""
+    fleet = _spawn_durable_fleet(tmp_path, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n0", prefix="rep")
+        prev = 0
+        for k in range(6):
+            st, raw, _ = req(fleet["n0"].port, "POST",
+                             f"/docs/{doc}/ops",
+                             body=_chain(5, 100, start=k * 100 + 1,
+                                         prev=prev))
+            prev = ts(5, (k + 1) * 100)
+            assert st == 200, raw
+        assert fleet["n1"].node.antientropy.sync_now() == {"n0": True}
+        for fs in fleet.values():
+            fs.node.engine.flush(timeout=120)
+
+        chain0 = _window_chain(fleet["n0"].port, doc)
+        assert chain0 == _window_chain(fleet["n1"].port, doc)
+
+        docdir1 = os.path.join(str(tmp_path), "n1", f"doc-{doc}")
+        segs = sorted(glob.glob(os.path.join(docdir1, "seg-*.npz")))
+        assert len(segs) >= 3
+        d1 = fleet["n1"].node.engine.get(doc)
+
+        # FIRST file (the since=0 fetch path) and a MIDDLE file (the
+        # terminator-anchored path), one after the other
+        def _chain_hits_503():
+            """Walk the window chain; True when it reaches the
+            quarantined hole and gets the typed refusal (windows
+            BEFORE the hole legitimately keep serving)."""
+            since = 0
+            for _ in range(1000):
+                st, raw, hdr = req(
+                    fleet["n1"].port, "GET",
+                    f"/docs/{doc}/ops?since={since}&limit=50")
+                if st == 503:
+                    assert "Retry-After" in hdr
+                    return True
+                assert st == 200, raw[:200]
+                if hdr.get("X-Since-More") != "1":
+                    return False
+                since = int(hdr["X-Since-Next"])
+            pytest.fail("window chain never terminated")
+
+        for victim in (segs[0], segs[len(segs) // 2]):
+            _flip_byte(victim)
+            rep = d1.tree._log.scrub()       # quarantine only
+            print("scrub report:", rep)
+            # pre-repair: the chain refuses (typed 503) at the hole —
+            # the corrupt bytes are never served
+            assert _chain_hits_503()
+            # values (published snapshot) keep serving
+            st, _, _ = req(fleet["n1"].port, "GET", f"/docs/{doc}")
+            assert st == 200
+            # the scrub pass heals from the peer
+            d1.run_scrub()
+            assert d1.tree._log.telemetry()["quarantined"] == 0
+            assert _window_chain(fleet["n1"].port, doc) == chain0, \
+                "post-repair windows must be byte-identical"
+
+        st = d1.scrub_stats
+        assert st["repaired"] == 2
+        # the corruption was counted by the direct log scrubs above
+        # (run_scrub skips already-quarantined files)
+        assert d1.tree._log.telemetry()["quarantines"] == 2
+        assert fleet["n1"].node.counters["repair_fetches"] == 2
+        # fingerprints equal across the fleet throughout
+        _, _, h0 = req(fleet["n0"].port, "GET", f"/docs/{doc}")
+        _, _, h1 = req(fleet["n1"].port, "GET", f"/docs/{doc}")
+        assert h0["X-State-Fingerprint"] == h1["X-State-Fingerprint"]
+
+        # the crdt_scrub_* families ride the strict scrape contract
+        st_, raw, _ = req(fleet["n1"].port, "GET", "/metrics/prom")
+        fams = prom_mod.parse_text(raw.decode())
+        for fam in ("crdt_scrub_runs_total",
+                    "crdt_scrub_files_checked_total",
+                    "crdt_scrub_corrupt_total",
+                    "crdt_scrub_repaired_total",
+                    "crdt_scrub_repair_failed_total",
+                    "crdt_scrub_matz_dropped_total",
+                    "crdt_scrub_quarantined_segments",
+                    "crdt_peer_health",
+                    "crdt_cluster_repair_fetches_total"):
+            assert fam in fams, fam
+    finally:
+        _stop_fleet(fleet)
+
+
+def test_fleet_repair_refuses_diverged_peer_rows(tmp_path,
+                                                 _small_tiers):
+    """A peer whose rows do not match the quarantined segment's
+    resident add index must be REFUSED — the quarantine stands rather
+    than poisoning the log with diverged history."""
+    fleet = _spawn_durable_fleet(tmp_path, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n0", prefix="div")
+        prev = 0
+        for k in range(4):
+            st, raw, _ = req(fleet["n0"].port, "POST",
+                             f"/docs/{doc}/ops",
+                             body=_chain(6, 100, start=k * 100 + 1,
+                                         prev=prev))
+            prev = ts(6, (k + 1) * 100)
+            assert st == 200, raw
+        assert fleet["n1"].node.antientropy.sync_now() == {"n0": True}
+        for fs in fleet.values():
+            fs.node.engine.flush(timeout=120)
+        d1 = fleet["n1"].node.engine.get(doc)
+        segs = sorted(glob.glob(os.path.join(
+            str(tmp_path), "n1", f"doc-{doc}", "seg-*.npz")))
+        _flip_byte(segs[1])
+        d1.tree._log.scrub()
+        quarantined = d1.tree._log.quarantined_segments()
+        assert len(quarantined) == 1
+        seg = quarantined[0]
+        # hand the repair WRONG rows (right length, different ts set)
+        bogus = packed_mod.pack(
+            [Add(ts(99, c + 1), (0,), "x") for c in range(seg.length)])
+        assert not d1.tree._log.repair_segment(seg, bogus)
+        assert d1.tree._log.telemetry()["quarantined"] == 1
+        # the honest fetch still heals it
+        d1.run_scrub()
+        assert d1.tree._log.telemetry()["quarantined"] == 0
+    finally:
+        _stop_fleet(fleet)
+
+
+def test_scrub_cadence_runs_on_maintenance_lane(tmp_path,
+                                                monkeypatch):
+    """GRAFT_SCRUB_INTERVAL_S arms the maintenance worker's policy
+    tick: corruption is found and healed WITHOUT anyone calling
+    run_scrub — the background lane owns it."""
+    monkeypatch.setenv("GRAFT_SCRUB_INTERVAL_S", "0.3")
+    monkeypatch.setenv("GRAFT_MATZ_TAIL_OPS", "64")
+    fleet = _spawn_durable_fleet(tmp_path, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n0", prefix="cad")
+        prev = 0
+        for k in range(4):
+            st, raw, _ = req(fleet["n0"].port, "POST",
+                             f"/docs/{doc}/ops",
+                             body=_chain(7, 100, start=k * 100 + 1,
+                                         prev=prev))
+            prev = ts(7, (k + 1) * 100)
+            assert st == 200, raw
+        assert fleet["n1"].node.antientropy.sync_now() == {"n0": True}
+        for fs in fleet.values():
+            fs.node.engine.flush(timeout=120)
+        d1 = fleet["n1"].node.engine.get(doc)
+        segs = sorted(glob.glob(os.path.join(
+            str(tmp_path), "n1", f"doc-{doc}", "seg-*.npz")))
+        _flip_byte(segs[0])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if d1.scrub_stats["repaired"] >= 1:
+                break
+            time.sleep(0.1)
+        assert d1.scrub_stats["repaired"] >= 1, d1.scrub_stats
+        assert d1.tree._log.telemetry()["quarantined"] == 0
+        maint = fleet["n1"].node.engine.maintenance
+        assert maint is not None
+        assert maint.stats()["tasks_done"].get("scrub", 0) >= 1
+        assert maint.stats()["scrubs_queued"] >= 1
+    finally:
+        _stop_fleet(fleet)
