@@ -66,6 +66,9 @@ class LoadgenConfig:
     delta_size: int = 10
     backspace_p: float = 0.15      # editor-replay flavor (config 1)
     burst_fraction: float = 0.5    # sessions that burst (no read between)
+    reads_per_write: int = 1       # >1 = the read-heavy shape (ISSUE 15
+    #                                readpath A/B: pollers re-reading a
+    #                                growing doc dominate the wall)
     max_queue_requests: int = 64   # small → 429 shedding is exercised
     giant_ops: int = 0             # 0 = no giant-merge racer
     stage_first_round: bool = True
@@ -120,30 +123,18 @@ class _Session(threading.Thread):
         # WAL headline bench prices the durability tax with
         self.ack_ms: List[float] = []
         self.errors: List[str] = []
-        self._conn: Optional[HTTPConnection] = None
 
     # -- transport --------------------------------------------------------
 
     def _request(self, method: str, path: str, body=None, headers=None):
-        """Keep-alive request with one reconnect retry (the server may
-        have closed an idle connection)."""
-        for attempt in (0, 1):
-            if self._conn is None:
-                self._conn = HTTPConnection(
-                    "127.0.0.1", self.h.port,
-                    timeout=self.h.cfg.read_timeout_s)
-            try:
-                self._conn.request(method, path, body=body,
-                                   headers=headers or {})
-                resp = self._conn.getresponse()
-                raw = resp.read()
-                return resp, raw
-            except (OSError, ConnectionError):
-                self._conn.close()
-                self._conn = None
-                if attempt:
-                    raise
-        raise RuntimeError("unreachable")
+        """One pooled keep-alive request (cluster/pool.py): the link
+        is ``(session, server)``, so reuse happens per session and the
+        report's pool counters prove persistent connections carried
+        the run."""
+        return self.h.pool.request(
+            self.sid, "server", "127.0.0.1", self.h.port,
+            method, path, body=body, headers=headers,
+            timeout=self.h.cfg.read_timeout_s)
 
     # -- traffic ----------------------------------------------------------
 
@@ -239,25 +230,31 @@ class _Session(threading.Thread):
                     return
                 # editor sessions read after every write (the
                 # read-your-writes probe); burst sessions only read at
-                # burst boundaries so their writes coalesce
+                # burst boundaries so their writes coalesce.  The
+                # read-heavy shape (reads_per_write > 1) re-polls the
+                # document after each acked write — the readpath A/B's
+                # traffic (ISSUE 15)
                 if not self.burst or (w + 1) % 3 == 0:
-                    if not self._read():
-                        return
+                    for _ in range(max(1, cfg.reads_per_write)):
+                        if not self._read():
+                            return
             self._read()
         except Exception as e:      # noqa: BLE001 — harness boundary
             self.errors.append(repr(e))
-        finally:
-            if self._conn is not None:
-                self._conn.close()
 
 
 class _Harness:
     def __init__(self, cfg: LoadgenConfig, engine: ServingEngine,
                  port: int, oracle: oracle_mod.SessionOracle):
+        from ..cluster.pool import ConnectionPool
         self.cfg = cfg
         self.engine = engine
         self.port = port
         self.oracle = oracle
+        # pooled keep-alive client connections (ISSUE 15) — the same
+        # pool the fleet paths use, plain factory (no chaos in
+        # single-server mode)
+        self.pool = ConnectionPool()
 
 
 def run(cfg: Optional[LoadgenConfig] = None,
@@ -278,9 +275,18 @@ def run(cfg: Optional[LoadgenConfig] = None,
     oracle.attach_engine(engine)
     srv = make_server(port=0, store=engine)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
+    harness = None
     try:
-        return _run(cfg, engine, oracle, srv)
+        harness = _Harness(cfg, engine, srv.server_port, oracle)
+        return _run(cfg, engine, oracle, srv, harness)
     finally:
+        # pool teardown mirrors run_fleet's finally: a mid-run
+        # exception must not leak idle keep-alive sockets (each pins a
+        # server handler thread on the next request line).  Harness
+        # construction sits INSIDE the try so a failure there still
+        # tears the server/oracle/engine down below.
+        if harness is not None:
+            harness.pool.close()
         # a mid-run exception must not leak the server, the scheduler
         # thread, or — worst in a test process — the oracle's listener
         # on a shared flight recorder (it would keep ingesting every
@@ -293,8 +299,8 @@ def run(cfg: Optional[LoadgenConfig] = None,
 
 
 def _run(cfg: LoadgenConfig, engine: ServingEngine,
-         oracle: oracle_mod.SessionOracle, srv) -> Dict[str, Any]:
-    harness = _Harness(cfg, engine, srv.server_port, oracle)
+         oracle: oracle_mod.SessionOracle, srv,
+         harness: _Harness) -> Dict[str, Any]:
     sessions = [_Session(harness, i) for i in range(cfg.n_sessions)]
 
     staged = False
@@ -327,10 +333,16 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         # else — it backs off through the 429s until admitted.
         def giant():
             nonlocal giant_s
-            conn = HTTPConnection("127.0.0.1", harness.port, timeout=600)
+
+            def greq(method, path, body=None, headers=None):
+                return harness.pool.request(
+                    "sess-giant", "server", "127.0.0.1", harness.port,
+                    method, path, body=body, headers=headers,
+                    timeout=600)
+
             try:
-                conn.request("POST", "/docs/load0/replicas")
-                rid = json.loads(conn.getresponse().read())["replica"]
+                resp, raw = greq("POST", "/docs/load0/replicas")
+                rid = json.loads(raw)["replica"]
                 ops, prev = [], 0
                 for i in range(cfg.giant_ops):
                     ts = rid * OFFSET + i + 1
@@ -340,12 +352,10 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
                 deadline = time.monotonic() + cfg.read_timeout_s
                 t0 = time.perf_counter()
                 while True:
-                    conn.request(
+                    resp, raw = greq(
                         "POST", "/docs/load0/ops", body=body,
                         headers={TRACE_HEADER: "giant-racer-push",
                                  SESSION_HEADER: "sess-giant"})
-                    resp = conn.getresponse()
-                    raw = resp.read()
                     if resp.status == 429:
                         if time.monotonic() > deadline:
                             giant_err.append("giant 429 never drained")
@@ -363,8 +373,6 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
                                              "giant-racer-push")
             except Exception as e:  # noqa: BLE001 — harness boundary
                 giant_err.append(repr(e))
-            finally:
-                conn.close()
         giant_thread = threading.Thread(target=giant, daemon=True)
         giant_thread.start()
     for s in sessions:
@@ -445,6 +453,7 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         "load_wall_s": round(load_wall_s, 3),
         "ops_per_sec": round(merged / load_wall_s, 1),
         "reads": n,
+        "reads_per_sec": round(n / load_wall_s, 1),
         "read_p50_ms": round(read_ms[n // 2], 3) if n else None,
         "read_p99_ms": round(read_ms[(99 * n) // 100], 3) if n else None,
         "read_max_ms": round(read_ms[-1], 3) if n else None,
@@ -481,6 +490,11 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         "shed_429": sum(s.shed_429 for s in sessions),
         "giant_ops": cfg.giant_ops,
         "giant_commit_s": round(giant_s, 3) if giant_s else None,
+        # read-path egress telemetry (ISSUE 15): the per-doc encoded-
+        # body caches aggregated, plus the client connection pool —
+        # reuses ≫ opens is the persistent-connection proof
+        "readcache": _aggregate_readcache(engine),
+        "connpool": harness.pool.stats(),
         "flushed": flushed,
         "oracle": ost,
         "violations": violations,
@@ -495,6 +509,23 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         # collective_bytes, leg}), chain_audit-style and never fatal
         "opsaxis": _opsaxis_report(),
     }
+    return out
+
+
+def _aggregate_readcache(engine) -> Dict[str, Any]:
+    """Engine-wide sum of the per-doc read-cache counters (the bench
+    headline's cache half)."""
+    out = {"enabled": bool(getattr(engine, "readcache_enabled", False)),
+           "hits": 0, "misses": 0, "encoded_bytes": 0,
+           "window_evictions": 0, "not_modified": 0}
+    for d in engine.docs():
+        rc = getattr(d, "readcache", None)
+        if rc is None:
+            continue
+        snap = rc.snapshot()
+        for k in ("hits", "misses", "encoded_bytes",
+                  "window_evictions", "not_modified"):
+            out[k] += snap[k]
     return out
 
 
@@ -532,7 +563,8 @@ def _opsaxis_report():
 class _FleetHarness:
     def __init__(self, cfg: LoadgenConfig,
                  oracle: oracle_mod.SessionOracle):
-        from ..cluster import MemoryKV, NetChaos
+        from ..cluster import ConnectionPool, MemoryKV, NetChaos
+        from ..cluster import netchaos as netchaos_mod
         self.cfg = cfg
         self.oracle = oracle
         self.kv = MemoryKV()
@@ -540,6 +572,16 @@ class _FleetHarness:
         # in-process fleet (link decision streams are per (src, dst))
         self.netchaos = NetChaos(cfg.seed, cfg.netchaos_spec) \
             if cfg.netchaos_spec else None
+        # pooled client links (ISSUE 15): session/giant traffic leases
+        # from the chaos pool when client links are armed (faults ride
+        # the pooled connections), the clean pool otherwise; harness
+        # verification requests always ride the clean pool
+        self.pool = ConnectionPool()
+        self.chaos_pool = ConnectionPool(
+            connect=lambda src, dst, host, port, timeout:
+            netchaos_mod.connect(self.netchaos, src, dst, host, port,
+                                 timeout)) \
+            if self.netchaos is not None else None
         self.servers: Dict[str, Any] = {}       # live name -> FleetServer
         self.dead: List[str] = []
         self.lock = threading.Lock()
@@ -621,26 +663,26 @@ class _FleetHarness:
     def request(self, fs, method: str, path: str, body=None,
                 headers=None, timeout: float = 60.0,
                 chaos_src: Optional[str] = None):
-        """One request to a fleet member.  ``chaos_src`` (a client
-        link name) routes it through the armed fault plan — session
-        traffic under ``netchaos_clients``; harness verification
-        requests never pass it."""
-        if chaos_src is not None and self.netchaos is not None \
+        """One POOLED request to a fleet member.  ``chaos_src`` (a
+        client link name) routes it through the armed fault plan's
+        pool — session traffic under ``netchaos_clients``; harness
+        verification requests always ride the clean pool so the
+        convergence checks measure the fleet, not the harness'
+        luck."""
+        if chaos_src is not None and self.chaos_pool is not None \
                 and self.cfg.netchaos_clients:
-            from ..cluster import netchaos as netchaos_mod
-            conn = netchaos_mod.connect(self.netchaos, chaos_src,
-                                        fs.name, "127.0.0.1", fs.port,
-                                        timeout)
+            pool = self.chaos_pool
         else:
-            conn = HTTPConnection("127.0.0.1", fs.port,
-                                  timeout=timeout)
-        try:
-            conn.request(method, path, body=body, headers=headers or {})
-            resp = conn.getresponse()
-            raw = resp.read()
-            return resp, raw
-        finally:
-            conn.close()
+            pool = self.pool
+        return pool.request(chaos_src or "harness", fs.name,
+                            "127.0.0.1", fs.port, method, path,
+                            body=body, headers=headers,
+                            timeout=timeout)
+
+    def close_pools(self) -> None:
+        self.pool.close()
+        if self.chaos_pool is not None:
+            self.chaos_pool.close()
 
     def observe_read(self, sid: str, doc: str, resp,
                      final: bool = False) -> None:
@@ -947,6 +989,7 @@ def run_fleet(cfg: Optional[LoadgenConfig] = None) -> Dict[str, Any]:
                 fs.stop()
             except Exception:   # noqa: BLE001 — teardown boundary
                 pass
+        h.close_pools()
     return report
 
 
@@ -1137,6 +1180,10 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         "ops_merged": sum(d.ops_merged for d in fs.node.engine.docs()),
         "node_id": fs.node.node_id(), "epoch": fs.node.epoch(),
         "antientropy": fs.node.antientropy.stats()["rounds"],
+        # pooled inter-node links (ISSUE 15): anti-entropy/forward/
+        # repair reuse, with chaos-poisoned evictions counted
+        "connpool": fs.node.pool.stats(),
+        "readcache": _aggregate_readcache(fs.node.engine),
     } for fs in h.live()}
     leaves = sum(s.leaves_acked for s in sessions) \
         + (cfg.giant_ops if cfg.giant_ops and "acked_s" in giant_state
@@ -1168,6 +1215,10 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         "kill": h.kill_report or None,
         "converged": converged,
         "per_server": per_server,
+        "connpool_clients": {
+            "clean": h.pool.stats(),
+            "chaos": h.chaos_pool.stats()
+            if h.chaos_pool is not None else None},
         "oracle": ost,
         "violations": violations,
         "prom_cluster_families": sorted(

@@ -27,7 +27,12 @@ fleet of peers instead of a privileged process:
   fault injection for every inter-node client path: seeded drop /
   delay / throttle / cut / dup faults and scheduled partition
   matrices (``GRAFT_NETCHAOS``), so a partition test is a replayable
-  artifact.
+  artifact;
+- :mod:`~crdt_graph_tpu.cluster.pool` — persistent keep-alive
+  connection pooling for every one of those client paths, threaded
+  through the ``netchaos.connect`` factory so chaos bites pooled
+  traffic exactly as it bit per-request connections (a cut poisons
+  exactly the pooled connection it hit).
 
 Run one node: ``python -m crdt_graph_tpu.cluster --name n0
 --kv-dir /tmp/fleet --port 8931``.
@@ -37,10 +42,11 @@ from .gateway import ClusterNode, FleetServer, ForwardError
 from .kv import FileKV, MemoryKV
 from .lease import Lease, LeaseError, LeaseLost, LeaseService
 from .netchaos import ChaosHTTPConnection, NetChaos, NetChaosSpecError
+from .pool import ConnectionPool
 from .ring import HashRing
 
 __all__ = ["AntiEntropy", "ChaosHTTPConnection", "ClusterNode",
-           "FileKV", "FleetServer",
+           "ConnectionPool", "FileKV", "FleetServer",
            "ForwardError", "HashRing", "Lease", "LeaseError",
            "LeaseLost", "LeaseService", "MemoryKV", "NetChaos",
            "NetChaosSpecError"]

@@ -317,30 +317,80 @@ class AntiEntropy(threading.Thread):
 
     # -- the wire ---------------------------------------------------------
 
-    def _connect(self, st: _PeerState, peer: str) -> HTTPConnection:
-        """Outbound connection to a peer, through the node's armed
-        fault plan (cluster/netchaos.py) when one exists — chaos rides
-        the SAME link the real traffic does."""
+    def _connect(self, st: _PeerState, peer: str,
+                 fresh: bool = False) -> HTTPConnection:
+        """Outbound connection to a peer: LEASED from the node's
+        pooled-connection pool (cluster/pool.py, threaded through the
+        armed netchaos plan — chaos rides the SAME link the real
+        traffic does); plain per-request netchaos.connect for embedded
+        nodes without a pool."""
         host, port = st.addr.rsplit(":", 1)
-        return netchaos_mod.connect(
-            getattr(self.node, "netchaos", None), self.node.name,
-            peer, host, int(port), self.http_timeout_s)
+        pool = getattr(self.node, "pool", None)
+        if pool is None:
+            return netchaos_mod.connect(
+                getattr(self.node, "netchaos", None), self.node.name,
+                peer, host, int(port), self.http_timeout_s)
+        return pool.lease(self.node.name, peer, host, int(port),
+                          self.http_timeout_s, fresh=fresh)
 
-    def _sync_peer(self, st: _PeerState) -> None:
-        conn = self._connect(st, st.name)
+    def _open_round(self, st: _PeerState, peer: str):
+        """Lease a connection and issue the round's FIRST request
+        (``GET /docs``), absorbing at most one stale keep-alive reuse
+        (a peer restarted on the same port invalidates pooled
+        connections; counting that as a peer failure would back off a
+        healthy peer — the same absorb ``ConnectionPool.request`` does
+        for the one-shot paths).  A stale failure mid-round stays a
+        genuine peer failure: the connection was just proven live.
+        Returns ``(conn, status, body)`` with the response fully
+        read."""
+        from .pool import STALE_ERRORS
+        conn = self._connect(st, peer)
         try:
             conn.request("GET", "/docs")
             resp = conn.getresponse()
-            body = resp.read()
-            if resp.status != 200:
-                raise _PeerFailure(f"GET /docs -> {resp.status}")
+            return conn, resp.status, resp.read()
+        except STALE_ERRORS:
+            reused = getattr(conn, "_pool_reused", False)
+            self._release(conn, ok=False)
+            if not reused:
+                raise
+        except BaseException:
+            self._release(conn, ok=False)
+            raise
+        conn = self._connect(st, peer, fresh=True)
+        try:
+            conn.request("GET", "/docs")
+            resp = conn.getresponse()
+            return conn, resp.status, resp.read()
+        except BaseException:
+            self._release(conn, ok=False)
+            raise
+
+    def _release(self, conn: HTTPConnection, ok: bool) -> None:
+        """A clean round returns the connection to the pool; ANY
+        failure poisons it (the pool evicts it and the next round
+        opens fresh — a chaos cut or a dead peer never leaves a
+        wounded connection behind for a later round)."""
+        pool = getattr(self.node, "pool", None)
+        if pool is None:
+            conn.close()
+        else:
+            pool.release(conn, ok=ok)
+
+    def _sync_peer(self, st: _PeerState) -> None:
+        conn, status, body = self._open_round(st, st.name)
+        ok = False
+        try:
+            if status != 200:
+                raise _PeerFailure(f"GET /docs -> {status}")
             docs = json.loads(body)["docs"]
             with self._lock:
                 st.known_docs = frozenset(docs)
             for doc in docs:
                 self._pull_doc(conn, st, doc)
+            ok = True
         finally:
-            conn.close()
+            self._release(conn, ok)
 
     def _probe_peer(self, st: _PeerState,
                     priority_doc: Optional[str]) -> None:
@@ -352,13 +402,11 @@ class AntiEntropy(threading.Thread):
         with self._lock:
             st.probes += 1
             self.probe_pulls += 1
-        conn = self._connect(st, st.name)
+        conn, status, body = self._open_round(st, st.name)
+        ok = False
         try:
-            conn.request("GET", "/docs")
-            resp = conn.getresponse()
-            body = resp.read()
-            if resp.status != 200:
-                raise _PeerFailure(f"GET /docs -> {resp.status}")
+            if status != 200:
+                raise _PeerFailure(f"GET /docs -> {status}")
             docs = json.loads(body)["docs"]
             with self._lock:
                 st.known_docs = frozenset(docs)
@@ -366,8 +414,9 @@ class AntiEntropy(threading.Thread):
                 (docs[0] if docs else None)
             if probe is not None:
                 self._pull_doc(conn, st, probe, max_windows=1)
+            ok = True
         finally:
-            conn.close()
+            self._release(conn, ok)
 
     def _pull_doc(self, conn: HTTPConnection, st: _PeerState,
                   doc: str, max_windows: Optional[int] = None) -> None:

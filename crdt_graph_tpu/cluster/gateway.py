@@ -50,6 +50,7 @@ from ..serve import ServingEngine
 from ..utils.hostenv import env_float as _env_float
 from . import kv as kv_mod
 from . import netchaos as netchaos_mod
+from . import pool as pool_mod
 from .antientropy import AntiEntropy
 from .lease import Lease, LeaseKeeper, LeaseService
 from .ring import HashRing
@@ -101,6 +102,15 @@ class ClusterNode:
         # — rides through it.
         self.netchaos = netchaos if netchaos is not None \
             else netchaos_mod.env_chaos()
+        # pooled inter-node connections (cluster/pool.py; ISSUE 15):
+        # every outbound path — anti-entropy, forwarding, repair — now
+        # leases from ONE per-node pool whose factory is
+        # netchaos.connect, so keep-alive reuse and fault injection
+        # compose (a cut poisons exactly the connection it hit)
+        self.pool = pool_mod.ConnectionPool(
+            connect=lambda src, dst, host, port, timeout:
+            netchaos_mod.connect(self.netchaos, src, dst, host, port,
+                                 timeout))
         # end-to-end write-forwarding deadline: the retry loop never
         # pins a client handler past this budget — exhausted, the
         # client gets 503 + Retry-After (ForwardError) and retries
@@ -194,6 +204,7 @@ class ClusterNode:
                 self.leases.release(self.lease)
             except Exception:   # noqa: BLE001 — shutdown boundary
                 pass
+        self.pool.close()
         self.engine.close(timeout=timeout)
 
     # -- membership / routing ---------------------------------------------
@@ -284,10 +295,6 @@ class ClusterNode:
                 return None
             primary, addr = route
             host, port = addr.rsplit(":", 1)
-            conn = netchaos_mod.connect(
-                self.netchaos, self.name, primary, host, int(port),
-                min(self.forward_timeout_s,
-                    max(0.05, deadline - time.monotonic())))
             try:
                 fwd = {"Content-Type": "application/json",
                        FORWARDED_HEADER: f"{self.name}.{self.epoch()}"}
@@ -295,10 +302,18 @@ class ClusterNode:
                     v = headers.get(h)
                     if v:
                         fwd[h] = v
-                conn.request("POST", f"/docs/{doc_id}/ops", body=body,
-                             headers=fwd)
-                resp = conn.getresponse()
-                out_body = resp.read()
+                # pooled relay (cluster/pool.py): a stale keep-alive
+                # connection retries once inside the pool (the relayed
+                # POST is idempotent — the CRDT absorbs a duplicate);
+                # a real failure poisons the pooled connection and
+                # burns a forward retry exactly as before
+                resp, out_body = self.pool.request(
+                    self.name, primary, host, int(port),
+                    "POST", f"/docs/{doc_id}/ops", body=body,
+                    headers=fwd,
+                    timeout=min(self.forward_timeout_s,
+                                max(0.05,
+                                    deadline - time.monotonic())))
                 out_headers = {h: resp.getheader(h)
                                for h in _RELAY_HEADERS
                                if resp.getheader(h)}
@@ -313,8 +328,6 @@ class ClusterNode:
                 # exactly what a chaos kill produces; it must burn a
                 # retry, not escape the loop
                 detail = repr(e)
-            finally:
-                conn.close()
         self._count("forwarded_err")
         raise ForwardError(doc_id, detail)
 
@@ -471,9 +484,9 @@ class ClusterNode:
         host, port = addr.rsplit(":", 1)
         pieces = []
         first = True
-        conn = netchaos_mod.connect(
-            self.netchaos, self.name, peer, host, int(port),
-            self.forward_timeout_s)
+        conn = self.pool.lease(self.name, peer, host, int(port),
+                               self.forward_timeout_s)
+        ok = True
         try:
             for _ in range(self.antientropy.max_windows_per_doc):
                 if pos >= stop:
@@ -509,8 +522,14 @@ class ClusterNode:
                 since = int(nxt)
             else:
                 return None
+        except BaseException:
+            # any transport/chaos failure poisons exactly this pooled
+            # connection; the outer repair_fetch catch decides whether
+            # it is a peer failure
+            ok = False
+            raise
         finally:
-            conn.close()
+            self.pool.release(conn, ok=ok)
         if pos < stop or not pieces:
             return None
         merged = pieces[0] if len(pieces) == 1 \
@@ -639,6 +658,8 @@ class ClusterNode:
             "max_staleness_s": self.max_staleness_s,
             "netchaos": None if self.netchaos is None
             else self.netchaos.stats(),
+            # pooled inter-node connections (cluster/pool.py)
+            "connpool": self.pool.stats(),
             "last_repair_err": self._last_repair_err,
         }
 

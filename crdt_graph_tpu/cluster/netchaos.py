@@ -55,6 +55,23 @@ generally lossy slow network::
 Schedules are indexed by the per-link REQUEST COUNTER, not wall time,
 so replays do not depend on thread timing.  Every decision draws from
 a per-link ``random.Random(f"{seed}|{src}>{dst}")`` stream.
+
+Pooled connections (cluster/pool.py; ISSUE 15): fleet clients now
+keep-alive and POOL their connections, created through :func:`connect`
+— the decision stream is consulted per WIRE REQUEST (``request()``
+calls ``decide``), so a long-lived pooled connection draws exactly the
+same per-link fault sequence per-request connections did, and the pool
+poisons (evicts) exactly the connection a cut/drop fired on.
+``getresponse`` fully buffers the real response before faulting at
+``read()``, so an injected cut never leaves stranded bytes that would
+corrupt the NEXT request on a reused connection.  Replay caveat: the
+counter indexes wire requests, so anything that RE-SENDS — a client
+429/503 retry loop, or the pool's one stale-reuse retry after a peer
+closed an idle connection — consumes an additional decision, exactly
+as it did pre-pooling; deterministic tier-1 matrices drive
+programmatic ``block``/``heal`` (counter-independent) or in-process
+fleets whose servers never idle-close, so their schedules replay
+verbatim.
 """
 from __future__ import annotations
 

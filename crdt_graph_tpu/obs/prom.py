@@ -239,6 +239,36 @@ def render_engine(engine) -> str:
                         h["bounds"], h["counts"], h["count"], h["sum"],
                         {"doc": d.doc_id})
 
+    # -- encoded-body read cache (serve/snapshot.py; ISSUE 15) ------------
+    # per-doc hit/miss/bytes counters of the per-generation wire-body
+    # cache + the window LRU, and the conditional-GET 304 counter
+    rdocs = [(d, d.readcache.snapshot()) for d in docs
+             if getattr(d, "readcache", None) is not None]
+    if rdocs:
+        w.gauge("crdt_readcache_enabled",
+                "1 when the encoded-body cache stores bodies "
+                "(GRAFT_READCACHE)",
+                1.0 if getattr(engine, "readcache_enabled", False)
+                else 0.0)
+        for name, help_text, key in (
+                ("crdt_readcache_hits_total",
+                 "Reads served from a cached encoded body", "hits"),
+                ("crdt_readcache_misses_total",
+                 "Reads that encoded a body (first touch per "
+                 "generation, or cache disabled)", "misses"),
+                ("crdt_readcache_encoded_bytes_total",
+                 "Bytes encoded on cache misses (the egress work "
+                 "actually paid)", "encoded_bytes"),
+                ("crdt_readcache_window_evictions_total",
+                 "Window-LRU entries evicted "
+                 "(GRAFT_READCACHE_WINDOWS)", "window_evictions"),
+                ("crdt_readcache_not_modified_total",
+                 "Conditional GETs answered 304 off the ETag "
+                 "contract", "not_modified")):
+            w.family(name, "counter", help_text)
+            for d, rc in rdocs:
+                w.sample(name, name, rc[key], {"doc": d.doc_id})
+
     # -- scrub & repair (docs/DURABILITY.md §Scrub & repair) --------------
     # rendered per tiered doc: the bit-rot sweep's verified/corrupt/
     # repaired counters plus the live quarantined-segment gauge
@@ -263,10 +293,23 @@ def render_engine(engine) -> str:
                  "repair_failed"),
                 ("crdt_scrub_matz_dropped_total",
                  "Corrupt matz artifacts dropped (re-derived at the "
-                 "next cadence)", "matz_dropped")):
+                 "next cadence)", "matz_dropped"),
+                # WAL-stream sweep (ISSUE 15 satellite): framing +
+                # crc32 walked on the same cadence — mid-log damage
+                # surfaces HERE (plus a flight dump), not at recovery
+                ("crdt_scrub_wal_records_total",
+                 "WAL records framing+crc-verified by the scrub "
+                 "sweep", "wal_records"),
+                ("crdt_scrub_wal_torn_tail_total",
+                 "Torn WAL tails seen by scrub (crash leftovers or "
+                 "an append racing the sweep — benign)",
+                 "wal_torn_tail"),
+                ("crdt_scrub_wal_mid_log_total",
+                 "Mid-log WAL corruption found by scrub (typed "
+                 "WalError class; flight-dumped)", "wal_mid_log")):
             w.family(name, "counter", help_text)
             for d, st, t in sdocs:
-                w.sample(name, name, st[key], {"doc": d.doc_id})
+                w.sample(name, name, st.get(key, 0), {"doc": d.doc_id})
         w.family("crdt_scrub_quarantined_segments", "gauge",
                  "Tier files currently quarantined (typed refusals "
                  "until repaired)")
@@ -663,6 +706,30 @@ def render_cluster(node) -> str:
     for peer, st in ae["peers"].items():
         for fname, _, _, key in peer_families:
             w.sample(fname, fname, st[key], {"peer": peer})
+    # pooled inter-node connections (cluster/pool.py; ISSUE 15): the
+    # persistent-connection proof (reuses ≫ opens on a healthy fleet)
+    # and the chaos interaction (poisoned = faults that evicted
+    # exactly the pooled connection they hit)
+    cp = cs.get("connpool")
+    if cp is not None:
+        for key, help_text in (
+                ("opens", "Pooled connections opened (cache misses)"),
+                ("reuses", "Requests served over a reused pooled "
+                           "connection"),
+                ("evictions", "Pooled connections evicted (idle "
+                              "overflow, max-age, server-close)"),
+                ("poisoned", "Pooled connections poisoned by a "
+                             "transport/chaos failure"),
+                ("stale_retries", "Requests retried once after a "
+                                  "stale keep-alive reuse")):
+            w.counter(f"crdt_connpool_{key}_total", help_text,
+                      cp.get(key, 0))
+        w.gauge("crdt_connpool_idle_connections",
+                "Idle pooled connections held right now",
+                cp.get("idle", 0))
+        w.gauge("crdt_connpool_links",
+                "Distinct (src,dst,host,port) links pooled",
+                cp.get("links", 0))
     # deterministic network fault injection (cluster/netchaos.py) —
     # rendered only when a fault plan is armed on this node
     nc = cs.get("netchaos")

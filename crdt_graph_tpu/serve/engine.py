@@ -148,12 +148,21 @@ class ServedDoc:
                     ephemeral=True, cache=engine.oplog_cache)
         self.queue = DocQueue(max_requests=engine.max_queue_requests,
                               max_leaves=engine.max_queue_leaves)
+        # encoded-body read cache (serve/snapshot.py; ISSUE 15): one
+        # stats/policy object per document, shared by every snapshot
+        # generation — invalidation is the publish pointer swap itself
+        self.readcache = snapshot_mod.ReadCacheStats(
+            enabled=engine.readcache_enabled,
+            window_cap=engine.readcache_windows)
         # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
         # the maintenance lane's cadence sweep re-verifies cold-file
         # checksums and heals quarantined ranges from fleet peers
         self.scrub_stats: Dict[str, int] = {
             "runs": 0, "checked": 0, "corrupt": 0, "repaired": 0,
-            "repair_failed": 0, "matz_dropped": 0}
+            "repair_failed": 0, "matz_dropped": 0,
+            # WAL-stream sweep (same cadence): record framing + crc32
+            # walked end to end; torn tail ≠ mid-log damage
+            "wal_records": 0, "wal_torn_tail": 0, "wal_mid_log": 0}
         self._last_scrub = time.monotonic()
         self.next_replica = 1
         self._replica_lock = threading.Lock()
@@ -167,7 +176,8 @@ class ServedDoc:
         self.coalesce_width = Histogram(WIDTH_BOUNDS)
         self.chunks_launched = 0
         self._seq = 0
-        self._snap = snapshot_mod.derive(doc_id, 0, self.tree)
+        self._snap = snapshot_mod.derive(doc_id, 0, self.tree,
+                                         stats=self.readcache)
         self._prev_snap: Optional[snapshot_mod.DocSnapshot] = None
         # everything restored/replayed so far is durable (or, for
         # non-durable docs, committed) — background spills may cover it
@@ -322,6 +332,32 @@ class ServedDoc:
         st["checked"] += report.get("checked", 0)
         st["corrupt"] += report.get("corrupt", 0)
         st["matz_dropped"] += report.get("matz_dropped", 0)
+        # WAL-stream scrub (ISSUE 15 satellite): walk the live stream's
+        # record framing + crc32 on the same cadence, so mid-log damage
+        # (real corruption — a typed WalError at recovery) is surfaced
+        # by prom + a flight dump NOW instead of first discovered when
+        # the process restarts.  A torn TAIL at scrub time is benign:
+        # either a crash leftover recovery would drop anyway, or an
+        # append racing the sweep — counted, never dumped on.  Shared-
+        # stream engines verify the ONE stream once per sweep cadence
+        # (engine-level latch), not once per document — the counters
+        # land on whichever doc's scrub drew the sweep.
+        if self.wal is not None:
+            if isinstance(self.wal, wal_mod.DocWalView):
+                v = self._engine.verify_shared_wal_once()
+            else:
+                v = self.wal.verify()
+            if v is not None:
+                st["wal_records"] += v["records"]
+                st["wal_torn_tail"] += v["torn_tail"]
+                if v["mid_log"]:
+                    st["wal_mid_log"] += v["mid_log"]
+                    self._engine.counters.add("wal_scrub_mid_log")
+                    try:
+                        self._engine.flight.dump(
+                            reason="wal-corruption")
+                    except Exception:  # noqa: BLE001 — recorder boundary
+                        pass
         fetcher = self._engine.repair_fetcher
         for seg in log.quarantined_segments():
             if fetcher is None:
@@ -354,7 +390,8 @@ class ServedDoc:
         one generation as the stale/regress target (obs/oracle.py)."""
         self._prepared_seq += 1
         return self.publish_prepared(snapshot_mod.derive(
-            self.doc_id, self._prepared_seq, self.tree))
+            self.doc_id, self._prepared_seq, self.tree,
+            stats=self.readcache))
 
     def prepare_publish(self) -> snapshot_mod.DocSnapshot:
         """Pipelined commit path, compute half (scheduler thread):
@@ -367,7 +404,7 @@ class ServedDoc:
         monotonicity is all readers rely on)."""
         self._prepared_seq += 1
         return snapshot_mod.derive(self.doc_id, self._prepared_seq,
-                                   self.tree)
+                                   self.tree, stats=self.readcache)
 
     def publish_prepared(self, snap: snapshot_mod.DocSnapshot) -> float:
         """Swap in a :meth:`prepare_publish` snapshot — the
@@ -507,6 +544,8 @@ class ServedDoc:
             "scrub": dict(self.scrub_stats,
                           quarantined=oplog_tele.get("quarantined", 0))
             if self.tree._log.tiering_enabled else None,
+            # encoded-body read cache (serve/snapshot.py; ISSUE 15)
+            "readcache": self.readcache.snapshot(),
         }
 
 
@@ -525,6 +564,8 @@ class ServingEngine:
                  submit_timeout_s: float = 600.0,
                  oplog_hot_ops: Optional[int] = None,
                  oplog_dir: Optional[str] = None,
+                 readcache: Optional[bool] = None,
+                 readcache_windows: Optional[int] = None,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
                  wal_shared: Optional[bool] = None,
@@ -542,6 +583,16 @@ class ServingEngine:
         # removed with the engine when it was auto-created.
         self.oplog_hot_ops = oplog_hot_ops if oplog_hot_ops is not None \
             else _env_int("GRAFT_OPLOG_HOT_OPS", DEFAULT_OPLOG_HOT_OPS)
+        # encoded-body read cache (serve/snapshot.py; ISSUE 15): on by
+        # default — GRAFT_READCACHE=0 restores the per-request
+        # re-encode path (the A/B baseline; wire bytes identical)
+        self.readcache_enabled = readcache if readcache is not None \
+            else os.environ.get("GRAFT_READCACHE",
+                                "1").strip() not in ("", "0")
+        self.readcache_windows = readcache_windows \
+            if readcache_windows is not None \
+            else _env_int("GRAFT_READCACHE_WINDOWS",
+                          snapshot_mod.DEFAULT_WINDOW_LRU)
         # crash durability (wal.py; docs/DURABILITY.md): a durable_dir
         # puts every document's tiers + WAL in a persistent per-doc
         # subdir; acked writes then survive a kill (fsync-before-ack,
@@ -644,6 +695,10 @@ class ServingEngine:
         # engines quarantine without healing (typed error on touch)
         self.scrub_interval_s = _env_float("GRAFT_SCRUB_INTERVAL_S",
                                            0.0)
+        # shared-WAL scrub latch: many docs share ONE stream, so the
+        # framing+crc sweep runs at most once per cadence engine-wide
+        self._shared_scrub_mu = threading.Lock()
+        self._shared_scrub_at = 0.0
         self.repair_fetcher = None
         # size/age spill-policy knobs (maintenance worker policy tick)
         self.oplog_hot_age_s = _env_float("GRAFT_OPLOG_HOT_AGE_S", 0.0)
@@ -712,6 +767,23 @@ class ServingEngine:
     @staticmethod
     def decode_ops(payload) -> Operation:
         return json_codec.loads(payload)
+
+    def verify_shared_wal_once(self) -> Optional[Dict]:
+        """One framing+crc walk of the shared WAL stream, deduped to
+        at most once per scrub cadence across ALL documents (each
+        doc's scrub task would otherwise re-scan the whole engine-wide
+        file N times per sweep — and report one corruption N times).
+        Returns the verify dict, or None when this cadence's sweep
+        already ran (the caller adds nothing)."""
+        if self.shared_wal is None:
+            return None
+        window = max(self.scrub_interval_s, 0.0)
+        now = time.monotonic()
+        with self._shared_scrub_mu:
+            if window > 0.0 and now - self._shared_scrub_at < window:
+                return None
+            self._shared_scrub_at = now
+        return self.shared_wal.verify()
 
     # -- write path -------------------------------------------------------
 

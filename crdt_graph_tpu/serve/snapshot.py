@@ -29,14 +29,87 @@ Derivation cost sits on the COMMIT path (the scheduler pre-warms the
 visible-value sequence before publishing), so the first read after a
 million-op merge is as cheap as any other read — the coalescer amortizes
 the per-commit derivation across every delta fused into that commit.
+
+Encoded-body cache (ISSUE 15): the same immutability makes the WIRE
+bytes cacheable — a published generation can never change under a
+cached body, so the snapshot lazily encodes-and-caches the bodies it
+serves: the ``{"values": ...}`` JSON of ``GET /docs/{id}``, the
+``{"replicas": ...}`` clock wire, and a bounded LRU of recent
+``ops_since_window`` wire bytes keyed by ``(since, limit)`` (the
+unbounded ``ops_since_bytes`` bootstrap path stays uncached — one-shot
+consumers, and an O(full log) body must not pin on a live snapshot).  Every reader of generation ``seq=k`` then gets
+the SAME ``bytes`` object and the HTTP layer ships a memoryview — the
+read path is O(what changed) per publish, not O(doc) per request.
+``GRAFT_READCACHE=0`` (or ``ServingEngine(readcache=False)``) disables
+storing — bodies still come from the same encoders, so cached and
+uncached wire bytes are identical by construction (the A/B bench's
+byte-identity flag).  The conditional-GET ``ETag`` is the quoted
+replica-independent :meth:`DocSnapshot.state_fingerprint`.
 """
 from __future__ import annotations
 
+import collections
+import json
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import engine as engine_mod
 from ..oplog import LogView
+
+# default bounded window-LRU entries per published snapshot (the
+# anti-entropy steady state re-pulls the same (since, limit) window of
+# an idle doc every round; catch-up chains stream distinct windows and
+# evict behind themselves)
+DEFAULT_WINDOW_LRU = 8
+
+
+class ReadCacheStats:
+    """One document's read-cache telemetry + policy: shared by every
+    snapshot generation the document publishes (the cache itself is
+    per-snapshot — invalidation IS the pointer swap).  Thread-safe;
+    rendered as the ``crdt_readcache_*`` prom families and stamped
+    into the loadgen report."""
+
+    __slots__ = ("enabled", "window_cap", "_mu", "hits", "misses",
+                 "encoded_bytes", "window_evictions", "not_modified")
+
+    def __init__(self, enabled: bool = True,
+                 window_cap: int = DEFAULT_WINDOW_LRU):
+        self.enabled = bool(enabled)
+        self.window_cap = max(1, int(window_cap))
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.encoded_bytes = 0
+        self.window_evictions = 0
+        self.not_modified = 0      # 304s served off the ETag contract
+
+    def hit(self) -> None:
+        with self._mu:
+            self.hits += 1
+
+    def miss(self, nbytes: int) -> None:
+        with self._mu:
+            self.misses += 1
+            self.encoded_bytes += int(nbytes)
+
+    def evicted(self) -> None:
+        with self._mu:
+            self.window_evictions += 1
+
+    def served_304(self) -> None:
+        with self._mu:
+            self.not_modified += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            return {"enabled": self.enabled,
+                    "window_cap": self.window_cap,
+                    "hits": self.hits, "misses": self.misses,
+                    "encoded_bytes": self.encoded_bytes,
+                    "window_evictions": self.window_evictions,
+                    "not_modified": self.not_modified}
 
 
 class DocSnapshot:
@@ -46,12 +119,15 @@ class DocSnapshot:
 
     __slots__ = ("doc_id", "seq", "view", "values", "clock", "replica",
                  "timestamp", "cursor", "max_depth", "log_length",
-                 "log_segments", "committed_at", "_fp", "_sfp")
+                 "log_segments", "committed_at", "_fp", "_sfp",
+                 "_stats", "_values_body", "_clock_body", "_etag",
+                 "_win_mu", "_win")
 
     def __init__(self, doc_id: str, seq: int, view: LogView,
                  values: Tuple[Any, ...], clock: Dict[int, int],
                  replica: int, timestamp: int, cursor: Tuple[int, ...],
-                 max_depth: int):
+                 max_depth: int,
+                 stats: Optional[ReadCacheStats] = None):
         self.doc_id = doc_id
         self.seq = seq
         self.view = view
@@ -69,6 +145,16 @@ class DocSnapshot:
         self.committed_at = time.time()
         self._fp: Optional[str] = None
         self._sfp: Optional[str] = None
+        # encoded-body cache (module docstring): filled lazily by the
+        # first reader of each wire shape; one stats object per
+        # DOCUMENT outlives the per-generation caches
+        self._stats = stats if stats is not None else ReadCacheStats()
+        self._values_body: Optional[bytes] = None
+        self._clock_body: Optional[bytes] = None
+        self._etag: Optional[str] = None
+        self._win_mu = threading.Lock()
+        # (kind, since, limit) -> cached wire result, LRU-ordered
+        self._win: "collections.OrderedDict" = collections.OrderedDict()
 
     # -- read endpoints ---------------------------------------------------
 
@@ -82,11 +168,89 @@ class DocSnapshot:
         return self.view.to_packed()
 
     def visible_values(self) -> List[Any]:
+        """The Python-list accessor — for IN-PROCESS callers (the
+        oracle, bench harnesses, embedded engines).  The HTTP layer
+        serves :meth:`values_body` instead: one O(doc) list copy +
+        ``json.dumps`` per request was the read path's dominant cost
+        at scale (ISSUE 15)."""
         return list(self.values)
 
     def clock_wire(self) -> Dict[str, int]:
         """The vector clock in wire shape (``GET /clock``)."""
         return {str(r): ts for r, ts in self.clock.items()}
+
+    # -- encoded-body cache (ISSUE 15) ------------------------------------
+
+    @property
+    def cache_stats(self) -> ReadCacheStats:
+        return self._stats
+
+    def values_body(self) -> bytes:
+        """The exact ``GET /docs/{id}`` wire body, encoded at most once
+        per published generation (lock-free: a racing first pair of
+        readers may both encode — same bytes, last store wins)."""
+        body = self._values_body
+        if body is not None:
+            self._stats.hit()
+            return body
+        body = json.dumps({"values": self.values}).encode()
+        self._stats.miss(len(body))
+        if self._stats.enabled:
+            self._values_body = body
+        return body
+
+    def clock_body(self) -> bytes:
+        """The ``GET /docs/{id}/clock`` wire body, cached like
+        :meth:`values_body`."""
+        body = self._clock_body
+        if body is not None:
+            self._stats.hit()
+            return body
+        body = json.dumps({"replicas": self.clock_wire()}).encode()
+        self._stats.miss(len(body))
+        if self._stats.enabled:
+            self._clock_body = body
+        return body
+
+    def etag(self) -> str:
+        """The conditional-GET entity tag: the QUOTED replica-
+        independent state fingerprint, so converged replicas hand out
+        interchangeable validators and a new commit (which changes the
+        clock/extent/values) always changes it."""
+        if self._etag is None:
+            self._etag = f'"{self.state_fingerprint()}"'
+        return self._etag
+
+    def _window_cached(self, key: Tuple, compute):
+        """Bounded LRU over recent window wire results.  The compute
+        runs OUTSIDE the lock (a cold window may load cold segments);
+        a racing miss computes twice and the last insert wins — both
+        results are byte-identical by the view contract."""
+        if not self._stats.enabled:
+            out = compute()
+            body = out[0] if isinstance(out, tuple) else out
+            # count the REAL encoded bytes even with storing disabled:
+            # the A/B baseline leg's encoded_bytes must stay comparable
+            # to the cached leg's (both mean "egress work paid")
+            self._stats.miss(len(body))
+            return out
+        with self._win_mu:
+            hit = self._win.get(key)
+            if hit is not None:
+                self._win.move_to_end(key)
+        if hit is not None:
+            self._stats.hit()
+            return hit
+        out = compute()
+        body = out[0] if isinstance(out, tuple) else out
+        self._stats.miss(len(body))
+        with self._win_mu:
+            self._win[key] = out
+            self._win.move_to_end(key)
+            while len(self._win) > self._stats.window_cap:
+                self._win.popitem(last=False)
+                self._stats.evicted()
+        return out
 
     def age_s(self) -> float:
         return time.time() - self.committed_at
@@ -136,16 +300,26 @@ class DocSnapshot:
         ``(wire_bytes, {"found", "more", "next_since", "count"})`` —
         byte-identical to ``engine.packed_since_window`` over the
         untiered full packing, at every tier seam (oplog.LogView
-        window contract)."""
-        return self.view.window(since, limit)
+        window contract).  Served through the per-snapshot window LRU:
+        the steady-state pull (every peer re-asking the same
+        ``(since, limit)`` of an idle doc every round) stops re-slicing
+        and re-encoding the window per request."""
+        return self._window_cached(
+            ("w", since, limit), lambda: self.view.window(since, limit))
 
     def ops_since_bytes(self, since: int) -> bytes:
         """Wire JSON for ``GET /ops?since=`` off the pinned view — the
         SAME egress bytes the live tree serves
         (``engine.packed_since_bytes``): the view's descriptors and
         indexes are immutable, so any number of readers can serve
-        pulls concurrently while a merge (or a spill) is in flight."""
-        return self.view.since_bytes(since)
+        pulls concurrently while a merge (or a spill) is in flight.
+        Deliberately NOT cached: the unbounded path is the one-shot
+        bootstrap (near-zero hit rate), and storing it would pin
+        O(full log) wire bytes on a live snapshot the entry-count LRU
+        cannot bound.  Counted as a miss — it IS egress work paid."""
+        body = self.view.since_bytes(since)
+        self._stats.miss(len(body))
+        return body
 
     def checkpoint_bytes(self, compress: bool = False) -> bytes:
         """The binary packed-checkpoint bytes (``GET /snapshot``), built
@@ -178,8 +352,8 @@ class DocSnapshot:
                 f"ops={self.log_length}, visible={len(self.values)})")
 
 
-def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree"
-           ) -> DocSnapshot:
+def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree",
+           stats: Optional[ReadCacheStats] = None) -> DocSnapshot:
     """Build the next snapshot from a just-committed tree.  Called by
     the scheduler thread (the tree's only writer) BEFORE resolving the
     merged requests, so a client's follow-up read always sees its own
@@ -198,4 +372,5 @@ def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree"
         timestamp=tree.timestamp,
         cursor=tuple(tree.cursor),
         max_depth=tree._max_depth,
+        stats=stats,
     )

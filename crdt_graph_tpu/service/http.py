@@ -43,6 +43,16 @@ attributable as writes and a session-guarantee checker
 (obs/oracle.py) can join every read to the commit stream.  Writes
 echo a well-formed client ``X-Session-Id`` too.
 
+Read-path egress (ISSUE 15; docs/SERVING.md §Read path & egress):
+document and snapshot reads carry an ``ETag`` (the quoted
+replica-independent state fingerprint) and honor ``If-None-Match`` —
+an unchanged document answers ``304`` with the full correlation
+header set (``X-Commit-Seq``/``X-Replica-*``/``X-Ae-Lag-Seconds``)
+but no body; the bounded-staleness 503 gate runs FIRST, so a 304
+never outranks the staleness contract.  200 bodies come from the
+snapshot's per-generation encoded-body cache (serve/snapshot.py) and
+ship as memoryviews — no per-request ``json.dumps`` or list copy.
+
 Run: ``python -m crdt_graph_tpu.service [port]`` or embed via
 ``serve(port)`` / ``make_server(port)``.
 
@@ -70,6 +80,8 @@ from __future__ import annotations
 
 import json
 import re
+import socket
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -94,6 +106,26 @@ from .store import DocumentStore
 _DOC = re.compile(r"^/docs/([A-Za-z0-9_.-]+)(/.*)?$")
 
 
+def etag_matches(header: Optional[str], etag: str) -> bool:
+    """Whether an ``If-None-Match`` header matches ``etag`` (the
+    snapshot's quoted state fingerprint).  RFC 7232 weak-comparison
+    shape: ``*`` matches anything, the list splits on commas, a ``W/``
+    prefix is ignored.  Malformed members simply fail to match — a
+    garbage header degrades to an unconditional GET, never an error
+    (ISSUE 15 satellite)."""
+    if not header:
+        return False
+    if header.strip() == "*":
+        return True
+    for tok in header.split(","):
+        tok = tok.strip()
+        if tok.startswith("W/"):
+            tok = tok[2:]
+        if tok == etag:
+            return True
+    return False
+
+
 DEFAULT_MAX_BODY = 128 << 20
 # ECHO_LIMIT (serve/engine.py): applied-ops echo cap in leaves; above it
 # the response carries the count only.  Imported, not redefined — the
@@ -111,16 +143,29 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             self._send_raw(code, json.dumps(payload).encode(),
                            headers=headers)
 
-        def _send_raw(self, code: int, body: bytes,
+        def _send_raw(self, code: int, body,
                       ctype: str = "application/json",
                       headers=None) -> None:
+            """Ship one response.  ``body`` may be any buffer — cached
+            snapshot bodies go out as a memoryview so a shared
+            generation-wide ``bytes`` object is never copied per
+            request.  A 304 carries its headers (the conditional-GET
+            contract: seq/replica/lag stamps intact) but no body, and
+            when the handler decided to close the connection the
+            client is TOLD so (keep-alive pools must not discover it
+            by a failed reuse)."""
             self.send_response(code)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Length",
+                             "0" if code == 304 else str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+            if self.close_connection:
+                self.send_header("Connection", "close")
             self.end_headers()
-            self.wfile.write(body)
+            if code != 304 and len(body):
+                self.wfile.write(body if isinstance(body, memoryview)
+                                 else memoryview(body))
 
         def _route(self) -> Tuple[Optional[str], str, dict]:
             url = urlparse(self.path)
@@ -252,11 +297,27 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 if hasattr(doc, "read_view"):
                     # body and headers come from the SAME snapshot: a
                     # checker correlating the fingerprint header to the
-                    # values body must never straddle a publish
+                    # values body must never straddle a publish.  The
+                    # body is the generation's CACHED encoding
+                    # (serve/snapshot.py) and the read is conditional:
+                    # If-None-Match against the state-fingerprint ETag
+                    # answers 304 with the full header set but no body
+                    # — polling readers of an idle doc stop paying
+                    # O(doc) egress.  The staleness gate above already
+                    # overrode this path with its 503 when the bound
+                    # was exceeded: a 304 never vouches for freshness
+                    # beyond what the lag stamp admits.
                     snap = doc.read_view()
-                    self._send(200, {"values": snap.visible_values()},
-                               headers=self._read_trace_headers(
-                                   snap, ae_lag_hdr=ae_lag_hdr))
+                    hdrs = self._read_trace_headers(
+                        snap, ae_lag_hdr=ae_lag_hdr)
+                    hdrs["ETag"] = snap.etag()
+                    if etag_matches(self.headers.get("If-None-Match"),
+                                    snap.etag()):
+                        snap.cache_stats.served_304()
+                        self._send_raw(304, b"", headers=hdrs)
+                    else:
+                        self._send_raw(200, snap.values_body(),
+                                       headers=hdrs)
                 else:       # legacy DocumentStore: no snapshot identity
                     self._send(200, {"values": doc.snapshot()})
             elif sub == "/ops":
@@ -310,11 +371,26 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 try:
                     if hasattr(doc, "read_view"):
                         snap = doc.read_view()
+                        hdrs = self._read_trace_headers(
+                            snap, ae_lag_hdr=ae_lag_hdr)
+                        hdrs["ETag"] = snap.etag()
+                        if etag_matches(
+                                self.headers.get("If-None-Match"),
+                                snap.etag()):
+                            # the 304 fires BEFORE checkpoint_bytes:
+                            # an unchanged bootstrap poll skips the
+                            # whole O(doc) npz assembly, not just the
+                            # egress
+                            snap.cache_stats.served_304()
+                            self._send_raw(
+                                304, b"",
+                                ctype="application/octet-stream",
+                                headers=hdrs)
+                            return
                         self._send_raw(
                             200, snap.checkpoint_bytes(),
                             ctype="application/octet-stream",
-                            headers=self._read_trace_headers(
-                                snap, ae_lag_hdr=ae_lag_hdr))
+                            headers=hdrs)
                     else:
                         self._send_raw(200, doc.snapshot_packed(),
                                        ctype="application/octet-stream")
@@ -326,7 +402,18 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                                      "retry_after_s": 5},
                                headers={"Retry-After": "5"})
             elif sub == "/clock":
-                self._send(200, {"replicas": doc.clock()})
+                if hasattr(doc, "snapshot_view"):
+                    # the clock wire body is cached per generation too.
+                    # Deliberately snapshot_view, NOT read_view: the
+                    # one-shot GRAFT_ORACLE_FAULT stale/regress faults
+                    # must fire on a VALUE/snapshot read (where the
+                    # oracle can catch them), never be consumed by a
+                    # clock poll — doc.clock() always read the
+                    # published snapshot directly
+                    self._send_raw(200,
+                                   doc.snapshot_view().clock_body())
+                else:
+                    self._send(200, {"replicas": doc.clock()})
             elif sub == "/metrics":
                 self._send(200, doc.metrics())
             else:
@@ -461,12 +548,49 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
 class ServingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that shuts an OWNED serving engine down with
     the server — the scheduler thread stops and any in-flight write
-    tickets resolve (503) before ``server_close`` returns."""
+    tickets resolve (503) before ``server_close`` returns.
+
+    Connections are HTTP/1.1 keep-alive (every client path pools them
+    through :class:`~crdt_graph_tpu.cluster.pool.ConnectionPool`), so
+    ``server_close`` also force-closes every ESTABLISHED connection:
+    stopping the accept loop alone would leave handler threads serving
+    pooled keep-alive connections of a "crashed" fleet member — a
+    zombie the per-request-connection era never had (the chaos tests'
+    kill semantics depend on a crash actually severing the wire)."""
 
     owned_engine: Optional[ServingEngine] = None
 
+    def __init__(self, *args, **kw):
+        self._conn_lock = threading.Lock()
+        self._live_conns: set = set()
+        super().__init__(*args, **kw)
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._live_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._live_conns.discard(request)
+        super().shutdown_request(request)
+
     def server_close(self):
         super().server_close()
+        with self._conn_lock:
+            live = list(self._live_conns)
+            self._live_conns.clear()
+        for sock in live:
+            # a hard RST-like severance: handler threads blocked on
+            # the next keep-alive request line wake with EOF and exit
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         if self.owned_engine is not None:
             self.owned_engine.close()
 

@@ -289,6 +289,66 @@ def scan(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
             for off, payload in raw], torn, good
 
 
+def _verify(path: str, magic: bytes,
+            chunk: int = 1 << 20) -> Dict:
+    """Shared body of ``Wal.verify``/``SharedWal.verify``: a STREAMING
+    framing + crc32 walk — ``scan``'s corruption taxonomy exactly
+    (torn tail counted, mid-log damage reported — never raised: the
+    scrub lane surfaces it via prom counters + a flight dump, it must
+    not kill maintenance) but O(chunk) memory, never a materialized
+    payload list (the sweep runs on a cadence over possibly-huge
+    streams)."""
+    out = {"records": 0, "torn_tail": 0, "mid_log": 0, "error": None}
+    try:
+        f = open(path, "rb")
+    except FileNotFoundError:
+        return out
+    with f:
+        size = os.fstat(f.fileno()).st_size
+        head = f.read(len(magic))
+        if not head:
+            return out
+        if head != magic:
+            out["mid_log"] = 1
+            out["error"] = f"WAL {path!r}: bad magic {head!r}"
+            return out
+        off = len(magic)
+        while off < size:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                out["torn_tail"] = 1          # torn header at EOF
+                return out
+            ln, want = _HDR.unpack(hdr)
+            end = off + _HDR.size + ln
+            if ln < _POS.size or ln > MAX_RECORD_BYTES or end > size:
+                # impossible length or truncated payload: only legal
+                # as the torn final record (same rule as _scan_raw)
+                out["torn_tail"] = 1
+                return out
+            crc = 0
+            left = ln
+            while left > 0:
+                piece = f.read(min(chunk, left))
+                if not piece:
+                    out["torn_tail"] = 1
+                    return out
+                crc = zlib.crc32(piece, crc)
+                left -= len(piece)
+            if crc & 0xFFFFFFFF != want:
+                if end == size:
+                    out["torn_tail"] = 1      # partial final write
+                else:
+                    out["mid_log"] = 1
+                    out["error"] = (
+                        f"WAL {path!r}: checksum mismatch at offset "
+                        f"{off} with {size - end} valid bytes beyond "
+                        f"it — mid-log corruption")
+                return out
+            out["records"] += 1
+            off = end
+    return out
+
+
 def scan_shared(path: str
                 ) -> Tuple[List[Tuple[int, str, int, bytes]], int, int]:
     """Parse a shared-stream WAL into ``(records, torn_dropped,
@@ -545,6 +605,25 @@ class Wal:
                 "torn_dropped": torn,
                 "base_len": base_len,
                 "log_len": tree.log_length}
+
+    # -- scrub (docs/DURABILITY.md §Scrub & repair; ISSUE 15) --------------
+
+    def verify(self) -> Dict:
+        """Walk the on-disk stream's record framing + crc32 without
+        decoding payloads — the maintenance lane's WAL sweep, so
+        mid-log damage surfaces on the scrub cadence instead of first
+        being discovered at recovery.  Returns ``{"records",
+        "torn_tail", "mid_log", "error"}``; a torn TAIL is the benign
+        class (a crash leftover recovery drops, or an append racing
+        the sweep), mid-log damage is the typed-:class:`WalError`
+        class recovery would refuse on."""
+        with self._mu:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass
+        return _verify(self.path, MAGIC)
 
     # -- lifecycle / telemetry ---------------------------------------------
 
@@ -879,6 +958,20 @@ class SharedWal:
                 out.setdefault(doc_id, []).append((end_pos, payload))
             return out
 
+    # -- scrub (same contract as Wal.verify) -------------------------------
+
+    def verify(self) -> Dict:
+        """Framing + crc32 walk of the shared stream (every document's
+        records in one pass — the per-doc facades all delegate
+        here)."""
+        with self._mu:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass
+        return _verify(self.path, SHARED_MAGIC)
+
     # -- lifecycle / telemetry ---------------------------------------------
 
     def size_bytes(self) -> int:
@@ -1009,6 +1102,12 @@ class DocWalView:
 
     def size_bytes(self) -> int:
         return self.shared.size_bytes()
+
+    def verify(self) -> Dict:
+        """The scrub sweep through the facade verifies the WHOLE
+        shared stream (this document's records have no standalone
+        framing of their own)."""
+        return self.shared.verify()
 
     def close(self) -> None:
         pass                    # the engine owns the shared stream

@@ -1,0 +1,268 @@
+"""Read-path egress headline (ISSUE 15): what the per-snapshot
+encoded-body cache buys on the read-heavy serving shape.
+
+Runs the SAME closed-loop session load (bench/loadgen.py — concurrent
+sessions against a real HTTP server over pooled keep-alive
+connections, oracle-checked) on one host, one engine knob apart,
+interleaved A/B per round:
+
+- ``cached`` — GRAFT_READCACHE on (default): every reader of a
+  published generation gets the same cached ``bytes`` body
+  (serve/snapshot.py), shipped as a memoryview;
+- ``seed``   — GRAFT_READCACHE off: the pre-ISSUE-15 path — every
+  ``GET /docs/{id}`` pays an O(doc) ``json.dumps`` over a fresh
+  ``visible_values()`` copy.
+
+The shape is read-heavy by construction: the ONE document is
+PRELOADED with 64k values (a long-lived doc, the serving story's
+steady state), then few sessions write small deltas and poll
+``reads_per_write`` times after every acked write — so the wall is
+dominated by read egress over a big doc, which is exactly the
+contrast under test (the seed leg pays an O(64k) ``visible_values``
+copy + ``json.dumps`` per read; the cached leg pays it once per
+publish).  Both legs run over the pooled transport — the pool is NOT
+the A/B variable.
+
+Reports per leg (best of ``rounds`` interleaved rounds): reads/s,
+reader p50/p99, the readcache counters, the connection-pool counters,
+and the oracle verdict (0 violations both legs or the run raises).
+The acceptance gate: ``cached`` ≥ 2× ``seed`` reads/s OR ``seed``
+p99 ≥ 2× ``cached`` p99.
+
+Two side checks ride along:
+
+- **wire identity** — one fixed write sequence served with the cache
+  on and off must produce byte-identical ``GET /docs/{id}`` bodies,
+  window bodies, and ETags (the cache is an egress optimization,
+  never a wire change);
+- **conditional polling** — a polling reader of an idle doc sends
+  ``If-None-Match`` and must get straight 304s carrying
+  ``X-Commit-Seq``, then a 200 with a NEW ETag after the next write.
+
+Writes BENCH_READPATH_r01_cpu.json (or ``out_path``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.bench import loadgen  # noqa: E402
+from crdt_graph_tpu.cluster.pool import ConnectionPool  # noqa: E402
+from crdt_graph_tpu.codec import json_codec  # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch  # noqa: E402
+from crdt_graph_tpu.obs import flight as flight_mod  # noqa: E402
+from crdt_graph_tpu.serve import ServingEngine  # noqa: E402
+from crdt_graph_tpu.service import make_server  # noqa: E402
+
+LEGS = ("cached", "seed")
+PRELOAD_OPS = 65_536
+_PRELOAD_BODY = None
+
+
+def _cfg() -> loadgen.LoadgenConfig:
+    return loadgen.LoadgenConfig(
+        n_sessions=8, n_docs=1, writes_per_session=10, delta_size=32,
+        backspace_p=0.0, burst_fraction=0.0, reads_per_write=10,
+        max_queue_requests=256, stage_first_round=False, seed=15)
+
+
+def _preload_body() -> str:
+    global _PRELOAD_BODY
+    if _PRELOAD_BODY is None:
+        _PRELOAD_BODY = _chain(99, PRELOAD_OPS)
+    return _PRELOAD_BODY
+
+
+def _one_leg(leg: str, cfg: loadgen.LoadgenConfig) -> dict:
+    engine = ServingEngine(
+        max_queue_requests=cfg.max_queue_requests,
+        readcache=(leg == "cached"),
+        flight=flight_mod.FlightRecorder(capacity=4096))
+    try:
+        # the long-lived doc: sessions (all on load0) read a document
+        # that is ALREADY 64k values when traffic starts
+        accepted, _ = engine.get("load0").apply_body(_preload_body())
+        assert accepted
+        rep = loadgen.run(cfg, engine=engine)
+    finally:
+        engine.close()
+    if rep["oracle"]["violations_total"]:
+        raise AssertionError(
+            f"{leg}: session-guarantee violations under load: "
+            f"{rep['violations'][:3]}")
+    if rep["errors"]:
+        raise AssertionError(f"{leg}: session errors: {rep['errors']}")
+    return {"reads": rep["reads"],
+            "reads_per_sec": rep["reads_per_sec"],
+            "read_p50_ms": rep["read_p50_ms"],
+            "read_p99_ms": rep["read_p99_ms"],
+            "ops_per_sec": rep["ops_per_sec"],
+            "load_wall_s": rep["load_wall_s"],
+            "readcache": rep["readcache"],
+            "connpool": rep["connpool"],
+            "oracle_checks": sum(rep["oracle"]["checks"].values()),
+            "violations": rep["oracle"]["violations_total"]}
+
+
+def _chain(rid: int, n: int, start: int = 1, prev: int = 0) -> str:
+    ops = []
+    for c in range(start, start + n):
+        ts = rid * 2**32 + c
+        ops.append(Add(ts, (prev,), f"r{rid}:{c}"))
+        prev = ts
+    return json_codec.dumps(Batch(tuple(ops)))
+
+
+def _wire_identity() -> dict:
+    """One fixed write sequence, cache on vs off: doc body, window
+    body, and ETag must be byte-identical."""
+    out = {}
+    for leg, enabled in (("cached", True), ("seed", False)):
+        engine = ServingEngine(readcache=enabled)
+        srv = make_server(port=0, store=engine)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        pool = ConnectionPool()
+        try:
+            resp, raw = pool.request(
+                "idcheck", "server", "127.0.0.1", srv.server_port,
+                "POST", "/docs/ab/ops", body=_chain(9, 64))
+            assert resp.status == 200, raw
+            resp, body = pool.request(
+                "idcheck", "server", "127.0.0.1", srv.server_port,
+                "GET", "/docs/ab")
+            resp2, wbody = pool.request(
+                "idcheck", "server", "127.0.0.1", srv.server_port,
+                "GET", "/docs/ab/ops?since=0&limit=16")
+            out[leg] = {"doc_body": body, "window_body": wbody,
+                        "etag": resp.getheader("ETag")}
+        finally:
+            pool.close()
+            srv.shutdown()
+            srv.server_close()
+            engine.close()
+    return {
+        "doc_body_identical":
+            out["cached"]["doc_body"] == out["seed"]["doc_body"],
+        "window_body_identical":
+            out["cached"]["window_body"] == out["seed"]["window_body"],
+        "etag_identical":
+            out["cached"]["etag"] == out["seed"]["etag"],
+        "doc_body_bytes": len(out["cached"]["doc_body"]),
+    }
+
+
+def _conditional_poll(polls: int = 50) -> dict:
+    """A polling reader of an idle doc: If-None-Match must answer 304
+    (with X-Commit-Seq) every time, then 200 + a new ETag after the
+    next write."""
+    engine = ServingEngine()
+    srv = make_server(port=0, store=engine)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    pool = ConnectionPool()
+    try:
+        def req(method, path, body=None, headers=None):
+            return pool.request("poller", "server", "127.0.0.1",
+                                srv.server_port, method, path,
+                                body=body, headers=headers)
+
+        resp, raw = req("POST", "/docs/p/ops", body=_chain(3, 32))
+        assert resp.status == 200, raw
+        resp, body = req("GET", "/docs/p")
+        etag = resp.getheader("ETag")
+        n304 = 0
+        seq_ok = True
+        for _ in range(polls):
+            resp, raw = req("GET", "/docs/p",
+                            headers={"If-None-Match": etag})
+            if resp.status == 304 and raw == b"":
+                n304 += 1
+            seq_ok = seq_ok and resp.getheader("X-Commit-Seq") is not None
+        resp, raw = req("POST", "/docs/p/ops",
+                        body=_chain(3, 1, start=33, prev=3 * 2**32 + 32))
+        assert resp.status == 200
+        resp, raw = req("GET", "/docs/p",
+                        headers={"If-None-Match": etag})
+        return {"polls": polls, "not_modified": n304,
+                "headers_on_304": seq_ok,
+                "write_invalidates":
+                    resp.status == 200 and resp.getheader("ETag") != etag,
+                "readcache": loadgen._aggregate_readcache(engine)}
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+        engine.close()
+
+
+def run(rounds: int = 3, out_path: str = "BENCH_READPATH_r01_cpu.json"
+        ) -> dict:
+    cfg = _cfg()
+    per_round = {leg: [] for leg in LEGS}
+    t0 = time.time()
+    for r in range(rounds):
+        for leg in LEGS:            # interleaved: same host, same shape
+            rep = _one_leg(leg, cfg)
+            per_round[leg].append(rep)
+            print(f"round {r} {leg}: {rep['reads_per_sec']} reads/s, "
+                  f"p99 {rep['read_p99_ms']} ms", flush=True)
+    best = {leg: max(per_round[leg], key=lambda x: x["reads_per_sec"])
+            for leg in LEGS}
+    p99 = {leg: min(x["read_p99_ms"] for x in per_round[leg])
+           for leg in LEGS}
+    ratio = round(best["cached"]["reads_per_sec"]
+                  / max(best["seed"]["reads_per_sec"], 1e-9), 3)
+    p99_ratio = round(p99["seed"] / max(p99["cached"], 1e-9), 3)
+    identity = _wire_identity()
+    conditional = _conditional_poll()
+    out = {
+        "bench": "readpath", "round": 1, "backend": "cpu",
+        "config": {"sessions": cfg.n_sessions, "docs": cfg.n_docs,
+                   "writes_per_session": cfg.writes_per_session,
+                   "delta_size": cfg.delta_size,
+                   "reads_per_write": cfg.reads_per_write,
+                   "rounds": rounds, "interleaved": True},
+        "legs": {leg: {"best": best[leg], "p99_best_ms": p99[leg],
+                       "all_rounds": [
+                           {"reads_per_sec": x["reads_per_sec"],
+                            "read_p99_ms": x["read_p99_ms"]}
+                           for x in per_round[leg]]}
+                 for leg in LEGS},
+        "reads_per_sec_ratio": ratio,
+        "p99_ratio": p99_ratio,
+        "gate": {"want": "reads/s >= 2x OR p99 halved",
+                 "pass": ratio >= 2.0 or p99_ratio >= 2.0},
+        "wire_identity": identity,
+        "conditional_poll": conditional,
+        "violations_total": sum(x["violations"]
+                                for leg in LEGS for x in per_round[leg]),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    assert identity["doc_body_identical"] \
+        and identity["window_body_identical"] \
+        and identity["etag_identical"], identity
+    assert conditional["not_modified"] == conditional["polls"], \
+        conditional
+    assert conditional["write_invalidates"], conditional
+    assert out["violations_total"] == 0
+    assert out["gate"]["pass"], (ratio, p99_ratio)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {out_path}: cached/seed reads/s ratio {ratio}x, "
+          f"p99 ratio {p99_ratio}x", flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    run(rounds=rounds)

@@ -311,6 +311,59 @@ def test_cut_mid_response_is_a_peer_failure_then_heals():
         _stop_fleet(fleet)
 
 
+def test_chaos_over_pooled_connections_poisons_and_reopens():
+    """ISSUE 15 acceptance: all inter-node traffic now rides the
+    per-node connection pool THROUGH netchaos.connect — an injected
+    cut poisons exactly the pooled connection it hit (evicted, never
+    reused), the next round reopens fresh, and a healthy steady state
+    reuses connections across rounds with zero acked loss."""
+    chaos = NetChaos(11, "")
+    print("REPLAY:", chaos.describe())
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0", "n1"), netchaos=chaos)
+    try:
+        doc = _doc_owned_by(fleet["n0"].node.ring(), "n0")
+        assert _post_retry(fleet["n0"].port, doc, _chain(1, 5))
+        ae = fleet["n1"].node.antientropy
+        pool = fleet["n1"].node.pool
+
+        # clean rounds: round 1 opens, round 2+ REUSE the pooled link
+        assert ae.sync_now() == {"n0": True}
+        opens_clean = pool.stats()["opens"]
+        assert opens_clean >= 1
+        assert ae.sync_now() == {"n0": True}
+        st = pool.stats()
+        assert st["opens"] == opens_clean       # no new connection
+        assert st["reuses"] >= 1
+
+        # arm cut=1: the response dies mid-body; the failure poisons
+        # the pooled connection (never returned to the idle set)
+        chaos.cut_p = 1.0
+        assert ae.sync_now() == {"n0": False}
+        st = pool.stats()
+        assert st["poisoned"] >= 1, st
+
+        # heal: the next round must OPEN a fresh connection (the
+        # poisoned one is gone) and fully converge — zero acked loss
+        chaos.cut_p = 0.0
+        assert ae.sync_now() == {"n0": True}
+        st2 = pool.stats()
+        assert st2["opens"] > opens_clean, (st, st2)
+        assert _values(fleet["n1"], doc) == [f"r1:{c}"
+                                             for c in range(1, 6)]
+        # partition blocks poison too (a drop fires before bytes move,
+        # but the caller cannot know — conservative eviction)
+        chaos.block("n1", "n0")
+        assert ae.sync_now() == {"n0": False}
+        assert pool.stats()["poisoned"] > st2["poisoned"]
+        chaos.heal()
+        assert ae.sync_now() == {"n0": True}
+    finally:
+        print("REPLAY:", chaos.describe(),
+              "pool:", fleet["n1"].node.pool.stats())
+        _stop_fleet(fleet)
+
+
 def test_dup_reordered_window_deliveries_absorb():
     """dup=1: every pull re-serves the link's previous response — the
     puller applies stale windows and its mark regresses, and the CRDT

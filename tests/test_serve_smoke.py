@@ -39,3 +39,9 @@ def test_serve_smoke_end_to_end():
     # coverage) before shutting down
     assert summary["flight"]["records_total"] >= 1
     assert summary["flight"]["trace_ids_seen"] >= 36   # 4×3×3 pushes
+    # pooled keep-alive clients (ISSUE 15): connections were REUSED
+    # (the smoke asserts reuses > opens internally too) and the old
+    # TIME_WAIT transport flake is gone by construction — a clean run
+    # fires zero genuine retries
+    assert summary["connpool"]["reuses"] > summary["connpool"]["opens"]
+    assert summary["transport_retries"] == 0
