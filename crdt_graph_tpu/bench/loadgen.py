@@ -49,7 +49,8 @@ from ..core.operation import Add, Batch, Delete
 from ..obs import oracle as oracle_mod
 from ..obs import prom as prom_mod
 from ..obs.trace import (COMMIT_SEQ_HEADER, SESSION_HEADER,
-                         SNAP_FP_HEADER, TRACE_HEADER)
+                         SINCE_NEXT_HEADER, SNAP_FP_HEADER,
+                         TRACE_HEADER, WATCH_EVENT_HEADER)
 from ..serve import ServingEngine
 
 OFFSET = 2**32
@@ -94,6 +95,18 @@ class LoadgenConfig:
     # own quiesce/verification requests always stay clean so the
     # convergence checks measure the fleet, not the harness' luck.
     netchaos_clients: bool = False
+    # -- watch fan-out mode (ISSUE 16) -----------------------------------
+    # long-poll watchers chasing the publish pointer via /watch while
+    # the write load runs: every delivery is oracle-observed (a
+    # watcher is a read session — monotonic reads must hold through
+    # notify/resume/shed), and report["watch"] carries both the
+    # client-side delivery counts and the server registries' stats
+    n_watchers: int = 0
+    watch_limit: int = 8192        # shared window cap: caught-up
+    #                                watchers ask the SAME (since,
+    #                                limit) → one encode per generation
+    watch_timeout_s: float = 2.0   # per-request park budget (also
+    #                                bounds harness teardown)
 
 
 class _Session(threading.Thread):
@@ -243,6 +256,86 @@ class _Session(threading.Thread):
             self.errors.append(repr(e))
 
 
+class _Watcher(threading.Thread):
+    """One long-poll watcher chasing a document's publish pointer
+    through ``/watch`` (ISSUE 16): park, wake, apply the resume mark
+    off the wire, repeat.  Deliveries feed the oracle under the
+    watcher's own session id — push reads must stay monotone through
+    notify, resume, heartbeat, AND slow-consumer shed — and the
+    heartbeat ETag rides back as ``If-None-Match`` so a caught-up
+    re-poll parks instead of re-delivering the terminator window."""
+
+    def __init__(self, harness: "_Harness", idx: int,
+                 stop: threading.Event):
+        super().__init__(name=f"loadgen-w{idx}", daemon=True)
+        self.h = harness
+        self.idx = idx
+        self.stop = stop
+        cfg = harness.cfg
+        self.sid = f"watch-{idx:04d}"
+        self.doc = f"load{idx % cfg.n_docs}"
+        self.deliveries = 0     # windows received (notify + resume + shed)
+        self.notifies = 0       # deliveries that woke a park
+        self.heartbeats = 0     # empty timeout responses
+        self.sheds = 0          # slow-consumer handoffs taken
+        self.rejected_429 = 0   # admission sheds at the registry door
+        self.bytes_rx = 0
+        self.errors: List[str] = []
+
+    def run(self) -> None:
+        cfg = self.h.cfg
+        since = 0
+        etag: Optional[str] = None
+        while not self.stop.is_set():
+            try:
+                hdrs = {SESSION_HEADER: self.sid}
+                if etag is not None:
+                    hdrs["If-None-Match"] = etag
+                resp, raw = self.h.pool.request(
+                    self.sid, "server", "127.0.0.1", self.h.port,
+                    "GET",
+                    f"/docs/{self.doc}/watch?since={since}"
+                    f"&limit={cfg.watch_limit}"
+                    f"&timeout={cfg.watch_timeout_s}",
+                    headers=hdrs,
+                    timeout=cfg.watch_timeout_s + 60)
+            except (OSError, HTTPException) as e:
+                if not self.stop.is_set():
+                    self.errors.append(repr(e))
+                return
+            if resp.status == 429:
+                self.rejected_429 += 1
+                time.sleep(min(float(resp.getheader("Retry-After")
+                                     or 1), 0.05))
+                continue
+            if resp.status == 404:
+                time.sleep(0.01)          # doc not yet created
+                continue
+            if resp.status != 200:
+                if not self.stop.is_set():
+                    self.errors.append(f"watch -> {resp.status}")
+                return
+            event = resp.getheader(WATCH_EVENT_HEADER)
+            etag = resp.getheader("ETag") or etag
+            nxt = resp.getheader(SINCE_NEXT_HEADER)
+            if nxt is not None:
+                since = int(nxt)
+            if event == "timeout":
+                self.heartbeats += 1
+                continue
+            if event == "shed":
+                self.sheds += 1
+            elif event == "notify":
+                self.notifies += 1
+            self.deliveries += 1
+            self.bytes_rx += len(raw)
+            seq = resp.getheader(COMMIT_SEQ_HEADER)
+            if seq is not None:
+                self.h.oracle.observe_read(
+                    self.sid, self.doc, int(seq),
+                    resp.getheader(SNAP_FP_HEADER))
+
+
 class _Harness:
     def __init__(self, cfg: LoadgenConfig, engine: ServingEngine,
                  port: int, oracle: oracle_mod.SessionOracle):
@@ -302,6 +395,13 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
          oracle: oracle_mod.SessionOracle, srv,
          harness: _Harness) -> Dict[str, Any]:
     sessions = [_Session(harness, i) for i in range(cfg.n_sessions)]
+    # watchers start FIRST so the earliest generations are delivered
+    # as notifies (parked wakes), not just resumes of history
+    watch_stop = threading.Event()
+    watchers = [_Watcher(harness, i, watch_stop)
+                for i in range(cfg.n_watchers)]
+    for wt in watchers:
+        wt.start()
 
     staged = False
     if cfg.stage_first_round and cfg.n_sessions >= 2:
@@ -380,6 +480,10 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     if cfg.giant_ops:
         giant_thread.join(600)
     load_wall_s = time.perf_counter() - t_start
+    # release the watchers: an in-flight park drains at its budget
+    watch_stop.set()
+    for wt in watchers:
+        wt.join(cfg.watch_timeout_s + 120)
 
     # quiescence: drain everything admitted above and flush the flight
     # stream (the barrier — no records_total polling), then the final
@@ -408,7 +512,8 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
 
     read_ms = sorted(m for s in sessions for m in s.read_ms)
     ack_ms = sorted(m for s in sessions for m in s.ack_ms)
-    errors = [e for s in sessions for e in s.errors] + giant_err
+    errors = [e for s in sessions for e in s.errors] + giant_err \
+        + [e for wt in watchers for e in wt.errors]
     merged = sum(d.ops_merged for d in engine.docs())
     n = len(read_ms)
     na = len(ack_ms)
@@ -494,6 +599,22 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         # body caches aggregated, plus the client connection pool —
         # reuses ≫ opens is the persistent-connection proof
         "readcache": _aggregate_readcache(engine),
+        # watch fan-out (ISSUE 16): client-side delivery counts next
+        # to the server registries' delivery-class stats + merged
+        # notify-latency percentiles
+        "watch": ({
+            "watchers": cfg.n_watchers,
+            "deliveries": sum(wt.deliveries for wt in watchers),
+            "notifies": sum(wt.notifies for wt in watchers),
+            "heartbeats": sum(wt.heartbeats for wt in watchers),
+            "sheds": sum(wt.sheds for wt in watchers),
+            "rejected_429": sum(wt.rejected_429 for wt in watchers),
+            "bytes_rx": sum(wt.bytes_rx for wt in watchers),
+            "deliveries_per_sec": round(
+                sum(wt.deliveries for wt in watchers) / load_wall_s,
+                1),
+            "server": _aggregate_watch(engine),
+        } if watchers else None),
         "connpool": harness.pool.stats(),
         "flushed": flushed,
         "oracle": ost,
@@ -526,6 +647,26 @@ def _aggregate_readcache(engine) -> Dict[str, Any]:
         for k in ("hits", "misses", "encoded_bytes",
                   "window_evictions", "not_modified"):
             out[k] += snap[k]
+    return out
+
+
+def _aggregate_watch(engine) -> Dict[str, Any]:
+    """Engine-wide sum of the per-doc watch-registry stats plus the
+    bucket-merged notify-latency percentiles (serve/watch.py)."""
+    from ..serve.watch import merge_notify_hists
+    out = {"admitted": 0, "rejected": 0, "notifies": 0, "resumes": 0,
+           "heartbeats": 0, "shed_slow": 0, "reaped": 0,
+           "registered": 0, "parked": 0}
+    exports = []
+    for d in engine.docs():
+        reg = getattr(d, "watch", None)
+        if reg is None:
+            continue
+        snap = reg.snapshot()
+        for k in out:
+            out[k] += snap.get(k, 0)
+        exports.append(reg.stats.notify_ms.export())
+    out["notify_ms"] = merge_notify_hists(exports)
     return out
 
 
@@ -1184,6 +1325,10 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         # repair reuse, with chaos-poisoned evictions counted
         "connpool": fs.node.pool.stats(),
         "readcache": _aggregate_readcache(fs.node.engine),
+        # watch fan-out (ISSUE 16): each member's registries — a
+        # watcher on a non-primary is served LOCAL generations, so
+        # its deliveries land here, not on the primary
+        "watch": _aggregate_watch(fs.node.engine),
     } for fs in h.live()}
     leaves = sum(s.leaves_acked for s in sessions) \
         + (cfg.giant_ops if cfg.giant_ops and "acked_s" in giant_state

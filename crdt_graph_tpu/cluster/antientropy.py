@@ -84,7 +84,8 @@ class _PeerFailure(Exception):
 
 class _PeerState:
     __slots__ = ("name", "addr", "hw", "hw_digest", "pulls",
-                 "ops_applied", "dup_windows_skipped", "failures",
+                 "ops_applied", "dup_windows_skipped",
+                 "dup_window_304s", "failures",
                  "fail_streak", "backoff_until", "last_ok", "last_err",
                  "known_docs", "health", "breaker_opens", "probes")
 
@@ -105,15 +106,20 @@ class _PeerState:
         # how a rejoining node knows a document it doesn't hold yet
         # EXISTS somewhere (the read path's 503-instead-of-404 hint)
         self.known_docs: frozenset = frozenset()
-        # doc -> (since, sha1(body)) of the last window APPLIED from
-        # this peer: `operations_since` serves the terminator row
+        # doc -> (since, quoted-sha1-etag) of the last window APPLIED
+        # from this peer: `operations_since` serves the terminator row
         # inclusively, so at steady state every round re-serves a
         # known-duplicate window — byte-identical to the one already
-        # applied — which must not churn the scheduler forever
+        # applied — which must not churn the scheduler forever.  The
+        # fingerprint doubles as the wire validator: the next re-pull
+        # of the same mark sends it as If-None-Match, and the peer's
+        # window ETag (serve/snapshot.py) answers a bodyless 304 —
+        # the steady-state dup skip without shipping the window at all
         self.hw_digest: Dict[str, tuple] = {}
         self.pulls = 0
         self.ops_applied = 0
         self.dup_windows_skipped = 0
+        self.dup_window_304s = 0
         self.failures = 0
         self.fail_streak = 0
         self.backoff_until = 0.0
@@ -424,14 +430,37 @@ class AntiEntropy(threading.Thread):
             since = st.hw.get(doc, 0)
             # the pull names its node: the peer folds this mark into
             # its causal-stability watermark (the gate on its op-log's
-            # checkpoint advancement + segment GC — docs/OPLOG.md)
+            # checkpoint advancement + segment GC — docs/OPLOG.md).
+            # When the mark hasn't moved since the last applied
+            # window, the stored fingerprint rides as If-None-Match:
+            # a peer whose window is unchanged answers a bodyless 304
+            # (marks still advance off the X-Since-* headers) — the
+            # steady-state idle fleet stops shipping known-duplicate
+            # windows entirely
+            hdrs = {AE_PEER_HEADER: self.node.name}
+            known = st.hw_digest.get(doc)
+            if known is not None and known[0] == since:
+                hdrs["If-None-Match"] = known[1]
             conn.request("GET", f"/docs/{doc}/ops?since={since}"
                                 f"&limit={self.delta_cap}",
-                         headers={AE_PEER_HEADER: self.node.name})
+                         headers=hdrs)
             resp = conn.getresponse()
             body = resp.read()
             if resp.status == 404:
                 return              # raced a just-created doc listing
+            if resp.status == 304:
+                # unchanged window: a dup skip that never left the
+                # peer's NIC — same bookkeeping as the digest skip
+                with self._lock:
+                    st.pulls += 1
+                    st.dup_windows_skipped += 1
+                    st.dup_window_304s += 1
+                nxt = resp.getheader(SINCE_NEXT_HEADER)
+                if nxt is not None:
+                    st.hw[doc] = int(nxt)
+                if resp.getheader(SINCE_MORE_HEADER) != "1":
+                    return
+                continue
             if resp.status != 200:
                 raise _PeerFailure(f"GET /ops -> {resp.status}")
             with self._lock:
@@ -442,8 +471,8 @@ class AntiEntropy(threading.Thread):
                 st.hw[doc] = 0      # peer lost our mark: full resync
                 continue
             if body != EMPTY_BATCH:
-                digest = (since, hashlib.sha1(body).digest())
-                if st.hw_digest.get(doc) == digest:
+                etag = f'"{hashlib.sha1(body).hexdigest()}"'
+                if st.hw_digest.get(doc) == (since, etag):
                     # byte-identical to the window already applied
                     # from this mark: the inclusive-terminator overlap
                     # (plus any trailing-delete tail) at steady state
@@ -454,7 +483,7 @@ class AntiEntropy(threading.Thread):
                     applied = self._apply(doc, body)
                     with self._lock:
                         st.ops_applied += applied
-                    st.hw_digest[doc] = digest
+                    st.hw_digest[doc] = (since, etag)
             nxt = resp.getheader(SINCE_NEXT_HEADER)
             if nxt is not None:
                 st.hw[doc] = int(nxt)
@@ -546,6 +575,7 @@ class AntiEntropy(threading.Thread):
                     "pulls": st.pulls,
                     "ops_applied": st.ops_applied,
                     "dup_windows_skipped": st.dup_windows_skipped,
+                    "dup_window_304s": st.dup_window_304s,
                     "failures": st.failures,
                     "fail_streak": st.fail_streak,
                     "backoff_s": max(0.0, round(

@@ -269,6 +269,64 @@ def render_engine(engine) -> str:
             for d, rc in rdocs:
                 w.sample(name, name, rc[key], {"doc": d.doc_id})
 
+    # -- watch/subscription fan-out (serve/watch.py; ISSUE 16) ------------
+    # per-doc registry occupancy plus the delivery-class counters and
+    # the notify latency histogram (pointer swap -> delivery)
+    wch = [(d, d.watch) for d in docs
+           if getattr(d, "watch", None) is not None]
+    if wch:
+        w.family("crdt_watch_parked", "gauge",
+                 "Watchers currently parked on the publish pointer")
+        w.family("crdt_watch_registered", "gauge",
+                 "Watcher slots currently admitted (parked + in "
+                 "flight)")
+        w.family("crdt_watch_max", "gauge",
+                 "Per-doc watcher admission cap (GRAFT_WATCH_MAX)")
+        for d, reg in wch:
+            c = reg.counts()
+            lbl = {"doc": d.doc_id}
+            w.sample("crdt_watch_parked", "crdt_watch_parked",
+                     c["parked"], lbl)
+            w.sample("crdt_watch_registered", "crdt_watch_registered",
+                     c["registered"], lbl)
+            w.sample("crdt_watch_max", "crdt_watch_max", c["max"], lbl)
+        for name, help_text, key in (
+                ("crdt_watch_admitted_total",
+                 "Watch requests admitted past the registry cap",
+                 "admitted"),
+                ("crdt_watch_rejected_total",
+                 "Watch requests shed 429 at the registry door",
+                 "rejected"),
+                ("crdt_watch_notifies_total",
+                 "Deliveries to a parked watcher (woken by a "
+                 "publish)", "notifies"),
+                ("crdt_watch_resumes_total",
+                 "Immediate deliveries (the window already had ops)",
+                 "resumes"),
+                ("crdt_watch_heartbeats_total",
+                 "Empty park-timeout responses and SSE keepalives",
+                 "heartbeats"),
+                ("crdt_watch_shed_slow_total",
+                 "Slow consumers handed back to polling "
+                 "(X-Watch-Event: shed)", "shed_slow"),
+                ("crdt_watch_reaped_total",
+                 "Dead watcher connections found at write time",
+                 "reaped")):
+            w.family(name, "counter", help_text)
+            for d, reg in wch:
+                w.sample(name, name, getattr(reg.stats, key),
+                         {"doc": d.doc_id})
+        w.family("crdt_watch_notify_ms", "histogram",
+                 "Notify latency: publish pointer swap to watcher "
+                 "delivery")
+        for d, reg in wch:
+            h = reg.stats.notify_ms.export()
+            w.histogram("crdt_watch_notify_ms",
+                        "Notify latency: publish pointer swap to "
+                        "watcher delivery",
+                        h["bounds"], h["counts"], h["count"], h["sum"],
+                        {"doc": d.doc_id})
+
     # -- scrub & repair (docs/DURABILITY.md §Scrub & repair) --------------
     # rendered per tiered doc: the bit-rot sweep's verified/corrupt/
     # repaired counters plus the live quarantined-segment gauge
@@ -680,6 +738,9 @@ def render_cluster(node) -> str:
          "ops_applied"),
         ("crdt_cluster_antientropy_failures_total", "counter",
          "Failed sync attempts against the peer", "failures"),
+        ("crdt_cluster_antientropy_dup_window_304s_total", "counter",
+         "Duplicate windows skipped by a bodyless conditional-GET "
+         "304 (ISSUE 16)", "dup_window_304s"),
         ("crdt_cluster_antientropy_sync_age_seconds", "gauge",
          "Seconds since the peer was last fully synced (the lag)",
          "sync_age_s"),

@@ -69,6 +69,16 @@ AE_PEER_HEADER = "X-Ae-Peer"
 # the replica is partitioned past the bound
 AE_LAG_HEADER = "X-Ae-Lag-Seconds"
 MAX_STALENESS_HEADER = "X-Max-Staleness"
+# delta-push fan-out (serve/watch.py; docs/SERVING.md §Watch &
+# fan-out): a watch delivery classifies itself — "notify" (delivered
+# to a parked watcher), "resume" (data was already waiting), "timeout"
+# (empty heartbeat; re-poll), "shed" (slow consumer handed back to
+# polling), "closed" (engine shutdown).  A shed delivery also carries
+# X-Watch-Resume-Since: the EXACT resumable window mark (the chain
+# contract makes resume lossless), so shedding is an honest handoff,
+# never silent data loss
+WATCH_EVENT_HEADER = "X-Watch-Event"
+WATCH_RESUME_HEADER = "X-Watch-Resume-Since"
 # rejoining-node catch-up (ISSUE 9): a fleet read of a document this
 # node doesn't hold yet — but a peer does — answers 503 + Retry-After
 # instead of 404, with this hint: the best local estimate of the ops

@@ -420,6 +420,45 @@ def make_seg_cache(cache_mb: Optional[int] = None,
     return _SegCache(cap, cap_bytes=max(0, int(cache_mb)) << 20)
 
 
+def _quarantine_manifest_extra(cs: "_ColdSeg") -> dict:
+    """The manifest payload of a quarantined descriptor: the flag,
+    plus — when the resident add index was built from the file's
+    HEALTHY bytes (``index_ok``) — the index itself (base64 of the
+    int64 ts / int32 pos columns), so a restart-inherited quarantine
+    can still refuse a diverged peer's repair rows and keep resolving
+    window marks in the covered range."""
+    import base64
+    out = {"quarantined": True}
+    if cs.index_ok:
+        out["add_index"] = {
+            "ts": base64.b64encode(
+                np.ascontiguousarray(cs.add_ts, np.int64)
+                .tobytes()).decode("ascii"),
+            "pos": base64.b64encode(
+                np.ascontiguousarray(cs.add_pos, np.int32)
+                .tobytes()).decode("ascii")}
+    return out
+
+
+def _decode_quarantine_index(entry: dict):
+    """``(add_ts, add_pos)`` from a quarantined manifest entry, or
+    ``None`` when absent/malformed — a garbled index degrades to the
+    indexless placeholder (slower resync, never a failed open)."""
+    import base64
+    import binascii
+    ai = entry.get("add_index")
+    if not isinstance(ai, dict):
+        return None
+    try:
+        ts = np.frombuffer(base64.b64decode(ai["ts"]), np.int64)
+        pos = np.frombuffer(base64.b64decode(ai["pos"]), np.int32)
+    except (KeyError, TypeError, ValueError, binascii.Error):
+        return None
+    if len(ts) != len(pos):
+        return None
+    return ts, pos
+
+
 class _ColdSeg:
     """One on-disk tier member (a spilled segment, or the base).
 
@@ -459,17 +498,26 @@ class _ColdSeg:
 
     @staticmethod
     def placeholder(path: str, start: int, length: int,
-                    cache: Optional[_SegCache]) -> "_ColdSeg":
+                    cache: Optional[_SegCache],
+                    add_ts: Optional[np.ndarray] = None,
+                    add_pos: Optional[np.ndarray] = None) -> "_ColdSeg":
         """A quarantined manifest entry reopened after a restart: the
-        slot keeps the tier layout contiguous, every load is a typed
-        refusal, and the empty add index simply fails to resolve
-        marks in the covered range (``found=0`` → the puller re-pulls
-        from an earlier mark — correct, just slower)."""
+        slot keeps the tier layout contiguous and every load is a
+        typed refusal.  When the manifest persisted the segment's
+        PRE-CORRUPTION add index (quarantine writes it alongside the
+        flag), the restart inherits it — ``index_ok`` stays True, so
+        peer repair keeps its divergence cross-check and window marks
+        in the covered range still resolve.  Without it the empty add
+        index simply fails to resolve marks in the covered range
+        (``found=0`` → the puller re-pulls from an earlier mark —
+        correct, just slower) and a repair cannot be cross-checked."""
+        inherited = add_ts is not None and add_pos is not None
         seg = _ColdSeg(path, start, length,
-                       np.zeros(0, np.int64), np.zeros(0, np.int32),
+                       add_ts if inherited else np.zeros(0, np.int64),
+                       add_pos if inherited else np.zeros(0, np.int32),
                        0, cache, False)
         seg.quarantined = True
-        seg.index_ok = False
+        seg.index_ok = inherited
         return seg
 
     @staticmethod
@@ -1451,12 +1499,12 @@ class OpLog:
             "base": None,
             "base_chunks": [{"file": os.path.basename(cs.path),
                              "start": cs.start, "len": cs.length,
-                             **({"quarantined": True}
+                             **(_quarantine_manifest_extra(cs)
                                 if cs.quarantined else {})}
                             for cs in self._bases],
             "segments": [{"file": os.path.basename(cs.path),
                           "start": cs.start, "len": cs.length,
-                          **({"quarantined": True}
+                          **(_quarantine_manifest_extra(cs)
                              if cs.quarantined else {})}
                          for cs in self._cold],
             "matz": dict(self._matz) if self._matz is not None
@@ -2094,7 +2142,9 @@ class OpLog:
                 fp = os.path.join(dir, e["file"])
                 log._bases.append(
                     _ColdSeg.placeholder(fp, e["start"], e["len"],
-                                         log._cache)
+                                         log._cache,
+                                         *(_decode_quarantine_index(e)
+                                           or (None, None)))
                     if e.get("quarantined") else
                     _ColdSeg.open(fp, e["start"], e["len"],
                                   log._cache))
@@ -2108,7 +2158,9 @@ class OpLog:
                 fp = os.path.join(dir, e["file"])
                 log._cold.append(
                     _ColdSeg.placeholder(fp, e["start"], e["len"],
-                                         log._cache)
+                                         log._cache,
+                                         *(_decode_quarantine_index(e)
+                                           or (None, None)))
                     if e.get("quarantined") else
                     _ColdSeg.open(fp, e["start"], e["len"],
                                   log._cache))

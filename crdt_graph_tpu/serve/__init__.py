@@ -9,7 +9,9 @@ from .queue import (QueueFull, SchedulerError, SchedulerStopped,
                     WalUnavailable)
 from .scheduler import MergeScheduler
 from .snapshot import DocSnapshot
+from .watch import WatchClosed, WatchFull, WatchRegistry, WatchStats
 
 __all__ = ["ECHO_LIMIT", "DocSnapshot", "MergeScheduler", "QueueFull",
            "SchedulerError", "SchedulerStopped", "ServedDoc",
-           "ServingEngine", "WalUnavailable"]
+           "ServingEngine", "WalUnavailable", "WatchClosed",
+           "WatchFull", "WatchRegistry", "WatchStats"]

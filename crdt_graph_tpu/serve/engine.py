@@ -45,6 +45,7 @@ from ..obs import oracle as oracle_mod
 from ..obs import trace as trace_mod
 from ..oplog import PackedBatch
 from . import snapshot as snapshot_mod
+from . import watch as watch_mod
 from .metrics import Counters, Histogram, LATENCY_BOUNDS_MS, WIDTH_BOUNDS
 from .queue import DocQueue, QueueFull, SchedulerStopped, WriteTicket
 
@@ -154,6 +155,13 @@ class ServedDoc:
         self.readcache = snapshot_mod.ReadCacheStats(
             enabled=engine.readcache_enabled,
             window_cap=engine.readcache_windows)
+        # delta-push fan-out (serve/watch.py; docs/SERVING.md §Watch &
+        # fan-out): bounded parked-watcher registry, woken by the
+        # publish pointer swap below (publish_prepared)
+        self.watch = watch_mod.WatchRegistry(
+            doc_id, max_watchers=engine.watch_max,
+            park_s=engine.watch_park_s,
+            heartbeat_s=engine.watch_heartbeat_s)
         # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
         # the maintenance lane's cadence sweep re-verifies cold-file
         # checksums and heals quarantined ranges from fleet peers
@@ -419,6 +427,13 @@ class ServedDoc:
             self._prev_snap = self._snap
         self._seq = snap.seq
         self._snap = snap
+        # wake parked watchers (serve/watch.py) AFTER the swap: a
+        # woken watcher re-reads the published pointer, so it can only
+        # ever serve this generation or a newer one — and because
+        # every durable mode calls publish_prepared strictly after the
+        # commit's fsync resolved, a watcher can never be shown a
+        # generation whose fsync could still roll back
+        self.watch.notify(snap.seq)
         return staleness
 
     def safe_extent(self) -> int:
@@ -546,6 +561,8 @@ class ServedDoc:
             if self.tree._log.tiering_enabled else None,
             # encoded-body read cache (serve/snapshot.py; ISSUE 15)
             "readcache": self.readcache.snapshot(),
+            # delta-push fan-out (serve/watch.py; ISSUE 16)
+            "watch": self.watch.snapshot(),
         }
 
 
@@ -566,6 +583,7 @@ class ServingEngine:
                  oplog_dir: Optional[str] = None,
                  readcache: Optional[bool] = None,
                  readcache_windows: Optional[int] = None,
+                 watch_max: Optional[int] = None,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
                  wal_shared: Optional[bool] = None,
@@ -593,6 +611,15 @@ class ServingEngine:
             if readcache_windows is not None \
             else _env_int("GRAFT_READCACHE_WINDOWS",
                           snapshot_mod.DEFAULT_WINDOW_LRU)
+        # delta-push fan-out (serve/watch.py; ISSUE 16): per-doc
+        # parked-watcher cap (429 past it), long-poll park budget
+        # ceiling, SSE heartbeat cadence
+        self.watch_max = watch_max if watch_max is not None \
+            else _env_int("GRAFT_WATCH_MAX", watch_mod.DEFAULT_WATCH_MAX)
+        self.watch_park_s = _env_float("GRAFT_WATCH_PARK_S",
+                                       watch_mod.DEFAULT_PARK_S)
+        self.watch_heartbeat_s = _env_float(
+            "GRAFT_WATCH_HEARTBEAT_S", watch_mod.DEFAULT_HEARTBEAT_S)
         # crash durability (wal.py; docs/DURABILITY.md): a durable_dir
         # puts every document's tiers + WAL in a persistent per-doc
         # subdir; acked writes then survive a kill (fsync-before-ack,
@@ -1013,6 +1040,12 @@ class ServingEngine:
         the WAL-sync worker (queued fsyncs drain — their acks must
         still resolve), then maintenance (abandons its queue:
         spill/fold/export work is idempotent and re-derivable)."""
+        # wake every parked watcher FIRST (they answer 503 and release
+        # their handler threads) — a watcher parked on a condition
+        # variable is invisible to socket severance, so without this a
+        # clean shutdown would stall up to a full park budget
+        for d in self.docs():
+            d.watch.close()
         self.scheduler.shutdown(timeout=timeout)
         if self.sync_worker is not None:
             self.sync_worker.stop(timeout=timeout)

@@ -121,7 +121,7 @@ class DocSnapshot:
                  "timestamp", "cursor", "max_depth", "log_length",
                  "log_segments", "committed_at", "_fp", "_sfp",
                  "_stats", "_values_body", "_clock_body", "_etag",
-                 "_win_mu", "_win")
+                 "_win_mu", "_win", "_win_inflight")
 
     def __init__(self, doc_id: str, seq: int, view: LogView,
                  values: Tuple[Any, ...], clock: Dict[int, int],
@@ -155,6 +155,12 @@ class DocSnapshot:
         self._win_mu = threading.Lock()
         # (kind, since, limit) -> cached wire result, LRU-ordered
         self._win: "collections.OrderedDict" = collections.OrderedDict()
+        # single-flight latches: key -> Event the compute leader sets
+        # (a watch notify wakes every parked watcher AT ONCE, and they
+        # all ask for the same window — without the latch the whole
+        # population would stampede-encode the body it is supposed to
+        # share)
+        self._win_inflight: Dict[Tuple, threading.Event] = {}
 
     # -- read endpoints ---------------------------------------------------
 
@@ -222,10 +228,13 @@ class DocSnapshot:
         return self._etag
 
     def _window_cached(self, key: Tuple, compute):
-        """Bounded LRU over recent window wire results.  The compute
-        runs OUTSIDE the lock (a cold window may load cold segments);
-        a racing miss computes twice and the last insert wins — both
-        results are byte-identical by the view contract."""
+        """Bounded LRU over recent window wire results, SINGLE-FLIGHT
+        per key.  The compute runs OUTSIDE the lock (a cold window may
+        load cold segments); concurrent misses on one key elect a
+        leader and the rest wait on its latch — a watch notify wakes a
+        whole watcher population at once, and one encode must serve
+        all of them (the fan-out contract the readcache counters
+        pin)."""
         if not self._stats.enabled:
             out = compute()
             body = out[0] if isinstance(out, tuple) else out
@@ -234,23 +243,45 @@ class DocSnapshot:
             # to the cached leg's (both mean "egress work paid")
             self._stats.miss(len(body))
             return out
-        with self._win_mu:
-            hit = self._win.get(key)
+        while True:
+            leader, ev = False, None
+            with self._win_mu:
+                hit = self._win.get(key)
+                if hit is not None:
+                    self._win.move_to_end(key)
+                else:
+                    ev = self._win_inflight.get(key)
+                    if ev is None:
+                        ev = threading.Event()
+                        self._win_inflight[key] = ev
+                        leader = True
             if hit is not None:
+                self._stats.hit()
+                return hit
+            if not leader:
+                # the leader inserts then sets the latch; on its
+                # failure (or an immediate eviction) the loop re-runs
+                # the election instead of dangling
+                ev.wait(60)
+                continue
+            try:
+                out = compute()
+            except BaseException:
+                with self._win_mu:
+                    self._win_inflight.pop(key, None)
+                ev.set()
+                raise
+            body = out[0] if isinstance(out, tuple) else out
+            self._stats.miss(len(body))
+            with self._win_mu:
+                self._win[key] = out
                 self._win.move_to_end(key)
-        if hit is not None:
-            self._stats.hit()
-            return hit
-        out = compute()
-        body = out[0] if isinstance(out, tuple) else out
-        self._stats.miss(len(body))
-        with self._win_mu:
-            self._win[key] = out
-            self._win.move_to_end(key)
-            while len(self._win) > self._stats.window_cap:
-                self._win.popitem(last=False)
-                self._stats.evicted()
-        return out
+                while len(self._win) > self._stats.window_cap:
+                    self._win.popitem(last=False)
+                    self._stats.evicted()
+                self._win_inflight.pop(key, None)
+            ev.set()
+            return out
 
     def age_s(self) -> float:
         return time.time() - self.committed_at
@@ -303,9 +334,24 @@ class DocSnapshot:
         window contract).  Served through the per-snapshot window LRU:
         the steady-state pull (every peer re-asking the same
         ``(since, limit)`` of an idle doc every round) stops re-slicing
-        and re-encoding the window per request."""
-        return self._window_cached(
-            ("w", since, limit), lambda: self.view.window(since, limit))
+        and re-encoding the window per request.
+
+        The meta dict additionally carries ``"etag"`` — the quoted
+        sha1 of the window's wire bytes, cached WITH the window (one
+        hash per encode, not per request): ``GET /ops`` serves it as
+        the window's ``ETag`` so a steady-state anti-entropy re-pull
+        of an unchanged window is a bodyless 304 on the wire (ISSUE
+        16 satellite), and the anti-entropy client's dup-window
+        digest compares against the same fingerprint."""
+
+        def compute():
+            import hashlib
+            body, meta = self.view.window(since, limit)
+            meta = dict(meta)
+            meta["etag"] = f'"{hashlib.sha1(body).hexdigest()}"'
+            return body, meta
+
+        return self._window_cached(("w", since, limit), compute)
 
     def ops_since_bytes(self, since: int) -> bytes:
         """Wire JSON for ``GET /ops?since=`` off the pinned view — the

@@ -22,6 +22,13 @@ companion text editor — interoperate unmodified):
   the missing suffix (server face of ``lastReplicaTimestamp``,
   CRDTree.elm:637-639)
 - ``GET  /docs/{id}``                  → ``{"values": [...]}`` (visible doc)
+- ``GET  /docs/{id}/watch?since=ts``   → delta-push fan-out
+  (serve/watch.py; docs/SERVING.md §Watch & fan-out): long-poll
+  (default; one ops window per response, parks until the next publish)
+  or SSE (``mode=sse``; one ``ops`` event per generation on a single
+  stream).  Bounded admission (429 past ``GRAFT_WATCH_MAX``),
+  slow-consumer shed with ``X-Watch-Resume-Since``, heartbeats, and
+  the bounded-staleness 503 gate ahead of parking.
 - ``GET  /docs/{id}/metrics`` and ``GET /metrics`` → counters
 - ``GET  /metrics/scheduler``          → serving-engine counters + spans
 - ``GET  /metrics/prom``               → unified Prometheus-style text
@@ -82,6 +89,7 @@ import json
 import re
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -96,12 +104,20 @@ from ..obs.trace import (AE_LAG_HEADER, AE_PEER_HEADER,
                          SESSION_HEADER,
                          SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
                          SINCE_NEXT_HEADER, SNAP_FP_HEADER,
-                         TRACE_HEADER, ensure_session_id,
+                         TRACE_HEADER, WATCH_EVENT_HEADER,
+                         WATCH_RESUME_HEADER, ensure_session_id,
                          ensure_trace_id, is_valid_id)
 from ..cluster.gateway import ForwardError
+from ..oplog import EMPTY_BATCH_BYTES
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
                      SchedulerStopped, ServingEngine)
+from ..serve.watch import WatchClosed, WatchFull
 from .store import DocumentStore
+
+# default and ceiling for one watch request's ops window (leaves): a
+# caught-up watcher population all asks for the same (since, limit), so
+# ONE value here is what makes the generation's encode shared
+DEFAULT_WATCH_LIMIT = 8192
 
 _DOC = re.compile(r"^/docs/([A-Za-z0-9_.-]+)(/.*)?$")
 
@@ -210,6 +226,188 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     snap, ae_lag_hdr=ae_lag_hdr))
             return out
 
+        @staticmethod
+        def _since_headers(hdrs: dict, meta: dict) -> None:
+            hdrs[SINCE_FOUND_HEADER] = "1" if meta["found"] else "0"
+            hdrs[SINCE_MORE_HEADER] = "1" if meta["more"] else "0"
+            if meta["next_since"] is not None:
+                hdrs[SINCE_NEXT_HEADER] = str(meta["next_since"])
+
+        @staticmethod
+        def _watch_fresh(meta, since) -> bool:
+            """Whether the window carries something a client parked at
+            ``since`` lacks.  ``count > 0`` alone cannot decide it:
+            the chain contract RE-SERVES the inclusive Add terminator,
+            so a fully caught-up mark still gets a non-empty window
+            (``next_since == since``).  Fresh means: unknown mark
+            (reset), a trimmed window (shed), or adds beyond the
+            terminator (``next_since`` moved)."""
+            return (not meta["found"] or bool(meta["more"])
+                    or (meta["count"] > 0
+                        and meta["next_since"] != since))
+
+        def _watch_poll(self, doc, reg, since, limit, timeout):
+            """One long-poll watch round trip (serve/watch.py): answer
+            immediately when the window already has new ops (*resume*),
+            else park on the registry until the next publish
+            (*notify* — latency measured from the pointer swap) or the
+            park budget (*timeout* — an empty-batch heartbeat bounding
+            how long a dead connection pins its slot, stamped with the
+            caught-up window's ``ETag`` so the re-poll can validate).
+            A woken watcher delivers whenever the published seq moved
+            past the one it parked on — even if ``next_since`` did not
+            (a delete-only tail grows the re-served window without
+            moving the terminator; duplicates absorb).  A first poll
+            carrying ``If-None-Match`` that does NOT match the window
+            etag also delivers — the exactness escape hatch for a
+            client whose delete tail predates its watch call.  A
+            delivery more than one window behind is a *shed*: the
+            window ships, plus the exact resumable mark
+            (``X-Watch-Resume-Since``) — the client polls ``/ops``
+            until caught up, losing nothing.  The correlation headers
+            resolve against the SAME snapshot as the body, and the lag
+            stamp is re-sampled at delivery time (a park can outlive
+            the admission-time sample)."""
+            deadline = time.monotonic() + timeout
+            parked, woke_at = False, 0.0
+            last_seq = None
+            inm = self.headers.get("If-None-Match")
+            while True:
+                snap = doc.snapshot_view()
+                body, meta = snap.ops_since_window(since, limit)
+                fresh = self._watch_fresh(meta, since)
+                if not fresh and last_seq is not None \
+                        and snap.seq > last_seq:
+                    # a commit landed while parked: the re-served
+                    # window carries its tail even when the terminator
+                    # (and so next_since) did not move
+                    fresh = True
+                if not fresh and last_seq is None and inm is not None \
+                        and not etag_matches(inm, meta["etag"]):
+                    fresh = True
+                if fresh:
+                    hdrs = self._read_trace_headers(snap)
+                    self._since_headers(hdrs, meta)
+                    hdrs["ETag"] = meta["etag"]
+                    if parked:
+                        reg.stats.observe_notify(
+                            (time.perf_counter() - woke_at) * 1e3)
+                        hdrs[WATCH_EVENT_HEADER] = "notify"
+                    else:
+                        reg.stats.add("resumes")
+                        hdrs[WATCH_EVENT_HEADER] = "resume"
+                    if meta["more"]:
+                        reg.stats.add("shed_slow")
+                        hdrs[WATCH_EVENT_HEADER] = "shed"
+                        hdrs[WATCH_RESUME_HEADER] = str(
+                            meta["next_since"])
+                    self._send_raw(200, body, headers=hdrs)
+                    return
+                last_seq = snap.seq
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    st, pub_at = "timeout", None
+                else:
+                    st, pub_at = reg.wait_beyond(snap.seq, remaining)
+                if st == "new":
+                    parked, woke_at = True, pub_at
+                    continue
+                if st == "closed":
+                    self._send(503, {"error": "engine shutting down"},
+                               headers={WATCH_EVENT_HEADER: "closed"})
+                    return
+                # timeout heartbeat: an EMPTY wire batch (nothing to
+                # re-send), resume mark unchanged, ETag = the caught-up
+                # window's validator for the next poll's If-None-Match
+                hdrs = self._read_trace_headers(snap)
+                self._since_headers(hdrs, meta)
+                hdrs["ETag"] = meta["etag"]
+                hdrs[WATCH_EVENT_HEADER] = "timeout"
+                reg.stats.add("heartbeats")
+                self._send_raw(200, EMPTY_BATCH_BYTES, headers=hdrs)
+                return
+
+        def _watch_sse(self, doc, reg, since, limit, timeout):
+            """Streamed watch (``mode=sse``): one response, one
+            ``ops`` event per generation (``id:`` = the resume mark),
+            comment heartbeats while idle.  The stream closes itself
+            on slow-consumer shed (``event: shed`` with the resumable
+            mark), on an unknown mark (``event: reset`` — resync via
+            snapshot), on the stream budget (``event: bye``), and on
+            engine shutdown (``event: closed``) — every close names
+            its reason; reconnect-with-mark is always exact.  What SSE
+            does NOT re-check per event: the bounded-staleness gate
+            ran once, at admission — a long-lived stream on a
+            partitioned replica keeps serving local generations;
+            clients needing a re-armed bound must reconnect."""
+            snap = doc.snapshot_view()
+            self.close_connection = True    # streams are not reusable
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            for k, v in self._read_trace_headers(snap).items():
+                self.send_header(k, v)
+            self.end_headers()
+            deadline = time.monotonic() + timeout
+            hb = max(0.05, reg.heartbeat_s)
+            parked, woke_at = False, 0.0
+            last_seq = None
+            while True:
+                snap = doc.snapshot_view()
+                body, meta = snap.ops_since_window(since, limit)
+                fresh = self._watch_fresh(meta, since) or (
+                    last_seq is not None and snap.seq > last_seq)
+                last_seq = snap.seq
+                if fresh:
+                    if parked:
+                        reg.stats.observe_notify(
+                            (time.perf_counter() - woke_at) * 1e3)
+                    else:
+                        reg.stats.add("resumes")
+                    parked = False
+                    ev = bytearray(b"event: ops\n")
+                    if meta["next_since"] is not None:
+                        ev += b"id: %d\n" % meta["next_since"]
+                    for line in bytes(body).split(b"\n"):
+                        ev += b"data: " + line + b"\n"
+                    ev += b"\n"
+                    self.wfile.write(ev)
+                    self.wfile.flush()
+                    if not meta["found"]:
+                        # unknown mark (we restarted with a fresh
+                        # log): the client must resync via /snapshot
+                        self.wfile.write(b"event: reset\ndata: {}\n\n")
+                        return
+                    if meta["next_since"] is not None:
+                        since = meta["next_since"]
+                    if meta["more"]:
+                        reg.stats.add("shed_slow")
+                        self.wfile.write(
+                            b"event: shed\ndata: "
+                            b'{"resume_since": %d}\n\n' % since)
+                        return
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.wfile.write(b"event: bye\ndata: "
+                                     b'{"resume_since": %d}\n\n'
+                                     % since)
+                    return
+                st, pub_at = reg.wait_beyond(
+                    snap.seq, min(hb, remaining))
+                if st == "closed":
+                    self.wfile.write(b"event: closed\ndata: {}\n\n")
+                    return
+                if st == "timeout":
+                    # keepalive comment: detects a dead consumer at
+                    # the next write instead of never
+                    reg.stats.add("heartbeats")
+                    self.wfile.write(b": hb\n\n")
+                    self.wfile.flush()
+                    continue
+                parked, woke_at = True, pub_at
+
         def do_GET(self):
             doc_id, sub, query = self._route()
             if doc_id is None:
@@ -263,7 +461,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 self._send(404, {"error": f"no document {doc_id}"})
                 return
             ae_lag_hdr = None
-            if sub in ("", "/snapshot") and \
+            if sub in ("", "/snapshot", "/watch") and \
                     hasattr(store, "check_staleness"):
                 # bounded-staleness read contract (docs/CLUSTER.md
                 # §Partitions & staleness): a read bounded by
@@ -345,7 +543,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 try:
                     if limit > 0 and hasattr(doc, "ops_since_window"):
                         body, meta = doc.ops_since_window(since, limit)
-                        self._send_raw(200, body, headers={
+                        hdrs = {
                             SINCE_FOUND_HEADER:
                                 "1" if meta["found"] else "0",
                             SINCE_MORE_HEADER:
@@ -354,7 +552,27 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                                 str(meta["next_since"])}
                                if meta["next_since"] is not None
                                else {}),
-                        })
+                        }
+                        # conditional window pull (ISSUE 16 satellite):
+                        # the window's content fingerprint is its ETag,
+                        # so a steady-state anti-entropy re-pull of an
+                        # unchanged window (every peer re-asking the
+                        # same (since, limit) of an idle doc every
+                        # round) becomes a bodyless 304 ON THE WIRE —
+                        # the X-Since-* resume state still rides the
+                        # headers, so the puller's mark advances
+                        # exactly as a 200 would have advanced it
+                        wetag = meta.get("etag")
+                        if wetag:
+                            hdrs["ETag"] = wetag
+                            if etag_matches(
+                                    self.headers.get("If-None-Match"),
+                                    wetag):
+                                if hasattr(doc, "readcache"):
+                                    doc.readcache.served_304()
+                                self._send_raw(304, b"", headers=hdrs)
+                                return
+                        self._send_raw(200, body, headers=hdrs)
                     else:
                         self._send_raw(200,
                                        doc.dumps_since_bytes(since))
@@ -367,6 +585,75 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     self._send(503, {"error": str(e),
                                      "retry_after_s": 5},
                                headers={"Retry-After": "5"})
+            elif sub == "/watch":
+                # delta-push fan-out (serve/watch.py; docs/SERVING.md
+                # §Watch & fan-out): park on the publish pointer, wake
+                # on the next generation, deliver the PR-15 cached
+                # window — one encode per generation shared by every
+                # watcher.  The staleness gate above already ran: a
+                # bounded-staleness 503 outranks parking.
+                if not hasattr(doc, "watch"):
+                    self._send(404, {"error": "watch requires the "
+                                              "serving engine"})
+                    return
+                reg = doc.watch
+                try:
+                    since = int(query.get("since", ["0"])[0])
+                    limit = int(query.get("limit", ["0"])[0]) \
+                        or DEFAULT_WATCH_LIMIT
+                    timeout = float(
+                        query.get("timeout", [""])[0] or reg.park_s)
+                except ValueError:
+                    self._send(400, {"error": "since, limit, timeout "
+                                              "must be numeric"})
+                    return
+                if limit < 0 or timeout < 0:
+                    self._send(400, {"error": "limit and timeout "
+                                              "must be >= 0"})
+                    return
+                mode = query.get("mode", ["poll"])[0]
+                # long-poll park is capped by the registry budget; an
+                # SSE stream legitimately spans many generations so it
+                # gets 10× (heartbeats bound dead-connection detection
+                # either way)
+                timeout = min(timeout, reg.park_s *
+                              (10.0 if mode == "sse" else 1.0))
+                try:
+                    # bounded admission: past GRAFT_WATCH_MAX the
+                    # watch is shed at the door, same semantic as the
+                    # write queue's 429
+                    reg.register()
+                except WatchFull as e:
+                    self._send(429, {"error": str(e),
+                                     "retry_after_s": e.retry_after_s},
+                               headers={"Retry-After":
+                                        str(e.retry_after_s)})
+                    return
+                except WatchClosed as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                try:
+                    if mode == "sse":
+                        self._watch_sse(doc, reg, since, limit,
+                                        timeout)
+                    else:
+                        self._watch_poll(doc, reg, since, limit,
+                                         timeout)
+                except CheckpointError as e:
+                    # quarantined tier range mid-watch: same typed
+                    # refusal as /ops — never corrupt bytes
+                    self._send(503, {"error": str(e),
+                                     "retry_after_s": 5},
+                               headers={"Retry-After": "5"})
+                except (BrokenPipeError, ConnectionResetError,
+                        ConnectionAbortedError, OSError):
+                    # the watcher hung up while parked or mid-write:
+                    # count the reap, release the slot (finally), and
+                    # let the connection die quietly
+                    reg.stats.add("reaped")
+                    self.close_connection = True
+                finally:
+                    reg.unregister()
             elif sub == "/snapshot":
                 try:
                     if hasattr(doc, "read_view"):

@@ -268,6 +268,74 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     summary["flight"] = {"records_total": flight["records_total"],
                          "trace_ids_seen": len(seen_ids)}
 
+    # watch fan-out (ISSUE 16): park watchers on the publish pointer,
+    # push ONE delta, and prove the whole population was served from
+    # ONE cached encode — byte-identical bodies, and the readcache
+    # counters pin exactly one miss (the encode) per generation with
+    # every other delivery a hit
+    wdoc = doc_ids[0]
+    st, raw, resp = req_full(
+        "GET", f"/docs/{wdoc}/ops?since=0&limit=100000")
+    assert st == 200
+    mark = int(resp.getheader("X-Since-Next"))
+    wd = srv.store.get(wdoc, create=False)
+    rc0 = wd.readcache.snapshot()
+    n_watch = 4
+    wresults = {}
+
+    def watch_leg(k):
+        st, raw, resp = req_full(
+            "GET",
+            f"/docs/{wdoc}/watch?since={mark}&limit=100000&timeout=30")
+        wresults[k] = (st, raw, resp.getheader("X-Watch-Event"))
+
+    wthreads = [threading.Thread(target=watch_leg, args=(k,),
+                                 daemon=True, name=f"smoke-watch-{k}")
+                for k in range(n_watch)]
+    for t in wthreads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while wd.watch.counts()["parked"] < n_watch:
+        assert time.monotonic() < deadline, "watchers never parked"
+        time.sleep(0.005)
+    st, raw = req("POST", f"/docs/{wdoc}/replicas")
+    wrid = json.loads(raw)["replica"]
+    st, raw = req("POST", f"/docs/{wdoc}/ops",
+                  json_codec.dumps(Batch(
+                      (Add(wrid * 2**32 + 1, (0,), "watched"),))))
+    assert st == 200 and json.loads(raw)["accepted"], raw
+    for t in wthreads:
+        t.join(60)
+    assert len(wresults) == n_watch, wresults
+    assert all(r[0] == 200 for r in wresults.values()), wresults
+    assert all(r[2] == "notify" for r in wresults.values()), wresults
+    assert len({r[1] for r in wresults.values()}) == 1, \
+        "watchers saw different bodies for one generation"
+    rc1 = wd.readcache.snapshot()
+    # two generations touched the shared window key (the pre-park
+    # caught-up check, then the delivery) — one encode each, every
+    # other watcher a cache hit
+    assert rc1["misses"] - rc0["misses"] == 2, (rc0, rc1)
+    assert rc1["hits"] - rc0["hits"] == 2 * (n_watch - 1), (rc0, rc1)
+    deadline = time.monotonic() + 10
+    while wd.watch.counts()["registered"]:
+        assert time.monotonic() < deadline, \
+            "watch registry never drained"
+        time.sleep(0.005)
+    st, raw = req("GET", "/metrics/prom")
+    assert st == 200
+    fams = prom_mod.parse_text(raw.decode())
+    assert "crdt_watch_notifies_total" in fams
+    assert "crdt_watch_notify_ms" in fams
+    notified = sum(v for _, lbl, v in
+                   fams["crdt_watch_notifies_total"]["samples"]
+                   if lbl["doc"] == wdoc)
+    assert notified >= n_watch, fams["crdt_watch_notifies_total"]
+    summary["watch"] = {
+        "watchers": n_watch,
+        "readcache_misses_delta": rc1["misses"] - rc0["misses"],
+        "readcache_hits_delta": rc1["hits"] - rc0["hits"]}
+
     # pooled-connection contract (ISSUE 15): persistent connections
     # actually carried the run (reuses ≫ opens — each client thread
     # issues many requests over its one pooled link), and the

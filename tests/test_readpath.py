@@ -3,6 +3,7 @@ cache, the conditional-GET (ETag / If-None-Match / 304) contract, the
 window-bytes LRU's seam identity, the pooled-connection layer, the new
 prom families, and the tier-1 cached-vs-re-encode perf ratio pin.
 """
+import hashlib
 import json
 import threading
 import time
@@ -211,7 +212,12 @@ def test_window_lru_seam_identity_and_eviction():
             ref_body, ref_meta = engine_mod.packed_since_window(
                 full, since, limit)
             assert body == ref_body       # seam-identical wire bytes
-            assert meta == ref_meta
+            # the cached window's meta additionally carries the wire
+            # validator (ISSUE 16): the quoted sha1 of the body
+            assert meta["etag"] == \
+                f'"{hashlib.sha1(body).hexdigest()}"'
+            assert {k: v for k, v in meta.items()
+                    if k != "etag"} == ref_meta
             # a repeat of the same key is a cache HIT on the same obj
             body2, meta2 = snap.ops_since_window(since, limit)
             assert body2 is body

@@ -225,6 +225,55 @@ def test_quarantine_survives_restart_manifest_roundtrip(
         eng2.close()
 
 
+def test_restart_inherited_quarantine_keeps_add_index(
+        tmp_path, _small_tiers):
+    """The quarantine manifest entry persists the PRE-corruption add
+    index (ISSUE 16 satellite): a restart-inherited quarantine still
+    holds the row fingerprint of the healthy bytes, so it can refuse a
+    diverged peer's repair instead of trusting whatever it is handed."""
+    import numpy as np
+    ddir = str(tmp_path / "srv")
+    eng = ServingEngine(durable_dir=ddir, oplog_hot_ops=64,
+                        flight=flight_mod.FlightRecorder())
+    _fill_doc(eng, "qi", 5)
+    doc = eng.get("qi")
+    live = doc.tree._log._bases + doc.tree._log._cold
+    victim = live[0]
+    want_ts = np.array(victim.add_ts, copy=True)
+    want_pos = np.array(victim.add_pos, copy=True)
+    _flip_byte(victim.path)
+    doc.run_scrub()
+    assert doc.tree._log.telemetry()["quarantined"] == 1
+    manifest = json.load(open(
+        os.path.join(ddir, "doc-qi", "manifest.json")))
+    entry = next(e for e in
+                 manifest["base_chunks"] + manifest["segments"]
+                 if e.get("quarantined"))
+    # the descriptor carries the healthy-bytes index verbatim
+    assert "add_index" in entry
+    eng.close()
+
+    eng2 = ServingEngine(durable_dir=ddir, oplog_hot_ops=64,
+                         flight=flight_mod.FlightRecorder())
+    try:
+        d2 = eng2.get("qi")
+        quarantined = d2.tree._log.quarantined_segments()
+        assert len(quarantined) == 1
+        seg = quarantined[0]
+        # the restart INHERITED the index rather than zeroing it
+        assert seg.index_ok
+        assert np.array_equal(seg.add_ts, want_ts)
+        assert np.array_equal(seg.add_pos, want_pos)
+        # so a diverged peer is still refused post-restart
+        bogus = packed_mod.pack(
+            [Add(ts(99, c + 1), (0,), "x")
+             for c in range(seg.length)])
+        assert not d2.tree._log.repair_segment(seg, bogus)
+        assert d2.tree._log.telemetry()["quarantined"] == 1
+    finally:
+        eng2.close()
+
+
 # -- fleet: scrub-with-peer-repair -------------------------------------------
 
 
