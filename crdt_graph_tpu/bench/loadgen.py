@@ -543,7 +543,23 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
                                  "batch_prepare", "batched_launch")),
         "fsync_queue": _stage_stats(("wal_fsync_queued",)),
         "fsync_wait": _stage_stats(("wal_fsync",)),
+        # the end-to-end durability stall per COMMIT (queue + wait
+        # summed before the percentile): the backend-fair A/B number —
+        # the serialized lane books its convoy in the queue stage, a
+        # completion-driven lane in the wait stage, and only the sum
+        # compares the two without flattering either accounting
+        "fsync_stall": _stage_stats(("wal_fsync_queued",
+                                     "wal_fsync")),
         "wal_append": _stage_stats(("wal_append",)),
+        # which group-commit sync lane produced these numbers (ISSUE
+        # 17): the A/B legs label the breakdown with the backend that
+        # actually RAN (auto-detect may downgrade a requested uring),
+        # and fsync_queue/fsync_wait are per-DOC — the completion-
+        # driven lane resolves each doc at ITS durability, so the
+        # split shows exactly what that buys vs one shared round stamp
+        "sync_backend": (engine.sync_worker.stats().get("backend")
+                         if getattr(engine, "sync_worker", None)
+                         is not None else None),
     }
     out = {
         "harness": "loadgen",

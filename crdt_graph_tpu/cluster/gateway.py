@@ -491,9 +491,15 @@ class ClusterNode:
             for _ in range(self.antientropy.max_windows_per_doc):
                 if pos >= stop:
                     break
+                # segment-sized repair pulls (ISSUE 17): ask for the
+                # whole remaining range at once when it exceeds the
+                # steady-state delta cap — a cold range on the peer
+                # then ships as ONE zero-copy sendfile plan instead
+                # of many small re-encoded windows
+                limit = max(self.antientropy.delta_cap, stop - pos)
                 conn.request(
                     "GET", f"/docs/{doc_id}/ops?since={since}"
-                           f"&limit={self.antientropy.delta_cap}")
+                           f"&limit={limit}")
                 resp = conn.getresponse()
                 body = resp.read()
                 if resp.status != 200:

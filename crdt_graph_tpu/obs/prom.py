@@ -526,6 +526,72 @@ def render_engine(engine) -> str:
         w.gauge("crdt_sched_pipeline_inflight",
                 "Fsync jobs queued or executing on the sync worker",
                 ps["inflight"])
+        # -- sync-backend fan-out (ISSUE 17; docs/DURABILITY.md §Sync
+        # backends): which lane the group-commit fsyncs ride, and how
+        # many are genuinely in flight on it right now — the A/B legs
+        # attribute fsync_wait to the right backend off these
+        w.gauge("crdt_wal_sync_backend",
+                "1 for the active group-commit sync backend "
+                "(GRAFT_WAL_SYNC_BACKEND)", 1.0,
+                {"backend": ps["backend"],
+                 "requested": ps["backend_requested"]})
+        w.gauge("crdt_wal_sync_inflight",
+                "Per-doc fsyncs currently in flight on the sync lane "
+                "(popped from the queue, durability not yet resolved)",
+                ps["sync_inflight"])
+
+    # -- host-shared encoded-body tier (serve/shmcache.py; ISSUE 17) ------
+    # rendered only when GRAFT_SHMCACHE armed a cache on a readcache-on
+    # engine — the default scrape is unchanged, like crdt_wal_*
+    shmcache = getattr(engine, "shmcache", None)
+    if shmcache is not None:
+        st = shmcache.stats.snapshot()
+        for name, help_text, key in (
+                ("crdt_shmcache_hits_total",
+                 "Generations served by attaching a segment another "
+                 "process encoded", "hits"),
+                ("crdt_shmcache_misses_total",
+                 "Generations this process encoded and published to "
+                 "the shared tier", "misses"),
+                ("crdt_shmcache_attach_failed_total",
+                 "Shared-tier degradations to the process-local path",
+                 "attach_failed"),
+                ("crdt_shmcache_shared_bytes_total",
+                 "Payload bytes served out of shared segments",
+                 "shared_bytes"),
+                ("crdt_shmcache_released_total",
+                 "Generation claims released at publish swaps and "
+                 "shutdown", "released"),
+                ("crdt_shmcache_scavenged_total",
+                 "Dead-process segments unlinked by the scavenger",
+                 "scavenged")):
+            w.counter(name, help_text, st[key])
+    # -- zero-copy cold egress (oplog.py wire sidecars; ISSUE 17) ---------
+    # rendered only when sendfile serving is armed (GRAFT_SENDFILE on a
+    # tiering engine) — same presence gating as crdt_wal_*
+    sendfile = getattr(engine, "sendfile_stats", None)
+    if sendfile is not None:
+        st = sendfile.snapshot()
+        for name, help_text, key in (
+                ("crdt_sendfile_windows_total",
+                 "Catch-up /ops windows shipped zero-copy via "
+                 "os.sendfile", "windows"),
+                ("crdt_sendfile_bytes_total",
+                 "Sidecar file bytes shipped zero-copy (page cache "
+                 "to socket, never materialized in-process)",
+                 "file_bytes"),
+                ("crdt_sendfile_fallback_total",
+                 "Cold-window plan attempts that fell back to the "
+                 "buffered path (sidecar building/refused/vanished)",
+                 "fallback"),
+                ("crdt_sendfile_sidecar_builds_total",
+                 "Wire sidecars built or reopened ready to serve",
+                 "sidecar_builds"),
+                ("crdt_sendfile_sidecar_build_failures_total",
+                 "Sidecar build/load attempts that failed "
+                 "(quarantine, verify mismatch, I/O error)",
+                 "sidecar_build_failures")):
+            w.counter(name, help_text, st.get(key, 0))
     # -- ops-axis sharded merge routing (parallel/opsaxis.py; ISSUE 13) ---
     from ..parallel import opsaxis as opsaxis_mod
     ax = opsaxis_mod.stats()

@@ -66,10 +66,12 @@ whole column set — the cascade bounds *resident* memory, not history.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
 import weakref
+import zipfile
 from collections import OrderedDict
 from typing import (Any, Callable, Dict, Iterable, Iterator, List,
                     Optional, Tuple, Union)
@@ -471,7 +473,7 @@ class _ColdSeg:
 
     __slots__ = ("path", "start", "length", "add_ts", "add_pos",
                  "file_bytes", "cache", "hints_vouched",
-                 "quarantined", "index_ok")
+                 "quarantined", "index_ok", "wire")
 
     def __init__(self, path: str, start: int, length: int,
                  add_ts: np.ndarray, add_pos: np.ndarray,
@@ -495,6 +497,12 @@ class _ColdSeg:
         # against it).
         self.quarantined = False
         self.index_ok = True
+        # wire sidecar state (zero-copy egress, ISSUE 17): None =
+        # unprobed, "building" = a build/load is queued or running,
+        # a WireIndex = ready to serve by sendfile, False = this
+        # segment can never serve zero-copy (non-JSON-native payload,
+        # failed verify) — the buffered path owns it forever
+        self.wire: Any = None
 
     @staticmethod
     def placeholder(path: str, start: int, length: int,
@@ -622,6 +630,205 @@ class _ColdSeg:
 
     def __len__(self) -> int:
         return self.length
+
+
+# -- zero-copy wire sidecars (ISSUE 17; docs/SERVING.md §Zero-copy
+# egress) --------------------------------------------------------------
+#
+# The /ops wire body is a pure concatenation:
+#
+#     b'{"op":"batch","ops":[' + b",".join(per-op JSON) + b']}'
+#
+# and a sealed segment is immutable — so its comma-joined per-op JSON
+# can be precomputed ONCE into a flat sidecar file (``<seg>.wire``)
+# with a row-offset index (``<seg>.wirex``).  A catch-up window that
+# lands entirely on cold tiers then ships as a handful of
+# ``os.sendfile`` ranges instead of load → unpack → re-encode per
+# pull.  The concatenation property is not assumed: the build VERIFIES
+# the assembled bytes against ``engine.packed_since_bytes`` over the
+# segment's own rows and permanently refuses zero-copy for the segment
+# on any mismatch (the buffered path owns it), and a sidecar reopened
+# from disk must pass a length + sha1 check before it serves.
+
+WIRE_PREFIX = b'{"op":"batch","ops":['
+WIRE_SUFFIX = b']}'
+
+
+def wire_paths(seg_path: str) -> Tuple[str, str]:
+    """(payload path, index path) of a segment's wire sidecar."""
+    return seg_path + ".wire", seg_path + ".wirex"
+
+
+class WireIndex:
+    """Resident index over one ``.wire`` sidecar: byte offset + length
+    of every row's JSON encoding (interior commas live between rows, so
+    rows [lo, hi) are ONE contiguous byte range)."""
+
+    __slots__ = ("path", "row_start", "row_len", "payload_len")
+
+    def __init__(self, path: str, row_start: np.ndarray,
+                 row_len: np.ndarray):
+        self.path = path
+        self.row_start = row_start
+        self.row_len = row_len
+        self.payload_len = (int(row_start[-1] + row_len[-1])
+                            if len(row_len) else 0)
+
+    def range_of(self, lo: int, hi: int) -> Tuple[int, int]:
+        """(offset, length) of rows [lo, hi) in the payload file —
+        includes the commas BETWEEN those rows, excludes any comma
+        before ``lo`` or after ``hi - 1``."""
+        off = int(self.row_start[lo])
+        end = int(self.row_start[hi - 1] + self.row_len[hi - 1])
+        return off, end - off
+
+
+def build_wire_sidecar(seg: "_ColdSeg") -> bool:
+    """Encode ``seg``'s rows into its wire sidecar (tmp + rename; the
+    index file lands LAST, so its presence is the ready flag).  Marks
+    ``seg.wire`` with the resident :class:`WireIndex` on success,
+    ``False`` permanently when the assembled bytes fail verification
+    against the buffered encoder, and back to ``None`` (retryable —
+    e.g. after peer repair) when the segment itself can't load."""
+    from . import engine as engine_mod
+    from .codec import json_codec
+    try:
+        p = seg.load()
+    except CheckpointError:
+        seg.wire = None
+        return False
+    n = p.num_ops
+    encs = [json_codec.dumps(op).encode()
+            for op in packed_mod.unpack_rows(p, 0, n)]
+    payload = b",".join(encs)
+    if WIRE_PREFIX + payload + WIRE_SUFFIX \
+            != engine_mod.packed_since_bytes(p, 0):
+        seg.wire = False
+        return False
+    row_len = np.asarray([len(e) for e in encs], dtype=np.int64)
+    row_start = np.zeros(n, np.int64)
+    if n > 1:
+        row_start[1:] = np.cumsum(row_len[:-1] + 1)
+    wp, xp = wire_paths(seg.path)
+    try:
+        tmp = wp + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, wp)
+        xtmp = xp + ".tmp"
+        digest = np.frombuffer(hashlib.sha1(payload).digest(),
+                               dtype=np.uint8).copy()
+        with open(xtmp, "wb") as f:
+            np.savez(f, row_start=row_start, row_len=row_len,
+                     digest=digest)
+        os.replace(xtmp, xp)
+    except OSError:
+        seg.wire = None
+        return False
+    seg.wire = WireIndex(wp, row_start, row_len)
+    return True
+
+
+def load_wire_index(seg: "_ColdSeg") -> bool:
+    """Reopen an existing sidecar pair (durable dirs persist them
+    across restarts).  The payload must match the index's row count,
+    total length, AND sha1 — a sidecar is serve-ready or it is
+    nothing; a stale/torn/bit-rotted one simply fails to load and the
+    caller rebuilds."""
+    wp, xp = wire_paths(seg.path)
+    try:
+        with np.load(xp) as z:
+            row_start = z["row_start"].astype(np.int64)
+            row_len = z["row_len"].astype(np.int64)
+            digest = z["digest"].tobytes()
+        if len(row_start) != seg.length or len(row_len) != seg.length:
+            return False
+        expect = (int(row_start[-1] + row_len[-1])
+                  if seg.length else 0)
+        if os.path.getsize(wp) != expect:
+            return False
+        h = hashlib.sha1()
+        with open(wp, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.digest() != digest:
+            return False
+    except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
+        return False
+    seg.wire = WireIndex(wp, row_start, row_len)
+    return True
+
+
+def ensure_wire_sidecar(seg: "_ColdSeg") -> bool:
+    """Idempotent load-or-build (the maintenance worker's ``wire``
+    task).  Returns readiness."""
+    if isinstance(seg.wire, WireIndex):
+        return True
+    if seg.wire is False:
+        return False
+    if seg.quarantined:
+        seg.wire = None
+        return False
+    if load_wire_index(seg):
+        return True
+    return build_wire_sidecar(seg)
+
+
+def drop_wire_sidecars(seg_path: str) -> None:
+    """Delete a segment's sidecar pair, if present — called wherever
+    the segment FILE is deleted (ephemeral close, watermark GC, repair
+    swap), so sidecars can never outlive or mismatch their segment."""
+    for p in wire_paths(seg_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+# module-wide plan-etag LRU: the window etag contract is "quoted sha1
+# of the wire bytes" (serve/snapshot.py), and the plan path must emit
+# the IDENTICAL validator without materializing the body per request.
+# Segment files are immutable and content-addressed by path, so the
+# hash is cached keyed by the plan's exact chunk identity — one
+# streaming read per distinct window, shared across snapshots and docs.
+_ETAG_LRU_CAP = 256
+_etag_mu = threading.Lock()
+_etag_lru: "OrderedDict[tuple, str]" = OrderedDict()
+
+
+def plan_etag(chunks: List[tuple]) -> Optional[str]:
+    """Quoted sha1 of a plan's assembled wire bytes (None when a
+    sidecar file vanished under us — caller falls back to buffered)."""
+    key = tuple(c[1:] if c[0] == "f" else c[1] for c in chunks)
+    with _etag_mu:
+        hit = _etag_lru.get(key)
+        if hit is not None:
+            _etag_lru.move_to_end(key)
+            return hit
+    h = hashlib.sha1()
+    try:
+        for c in chunks:
+            if c[0] == "b":
+                h.update(c[1])
+            else:
+                _, path, off, ln = c
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    remaining = ln
+                    while remaining:
+                        b = f.read(min(1 << 20, remaining))
+                        if not b:
+                            raise OSError(f"short read in {path!r}")
+                        h.update(b)
+                        remaining -= len(b)
+    except OSError:
+        return None
+    etag = f'"{h.hexdigest()}"'
+    with _etag_mu:
+        _etag_lru[key] = etag
+        while len(_etag_lru) > _ETAG_LRU_CAP:
+            _etag_lru.popitem(last=False)
+    return etag
 
 
 # one view part: (tag, payload, lo, hi, gstart) — tag "obj" (list of
@@ -850,17 +1057,37 @@ class LogView:
         p = self._single_full_packed()
         if p is not None:
             return engine_mod.packed_since_window(p, since, limit)
+        start, stop, early = self._window_bounds(since, limit)
+        if early is not None:
+            return EMPTY_BATCH_BYTES, early
+        n = self.length
+        sub = self.slice_packed(start, stop)
+        body = engine_mod.packed_since_bytes(sub, 0)
+        served = np.nonzero(sub.kind[:sub.num_ops] == KIND_ADD)[0]
+        next_since = int(sub.ts[int(served[-1])]) if len(served) \
+            else None
+        return body, {"found": True, "more": stop < n,
+                      "next_since": next_since, "count": stop - start}
+
+    def _window_bounds(self, since: int, limit: int):
+        """The window's row bounds — the SINGLE trimming implementation
+        behind both :meth:`window` (buffered) and :meth:`window_plan`
+        (zero-copy), so the two paths can never disagree on what a
+        window contains.  Returns ``(start, stop, None)`` for a
+        non-empty window or ``(0, 0, meta)`` when the answer is the
+        empty batch (unresolved mark / caught-up log), with ``meta``
+        exactly what :meth:`window` serves for that case."""
         n = self.length
         if since == 0:
             start = 0
         else:
             start = self.index_of_add(since)
             if start is None or start >= n:
-                return EMPTY_BATCH_BYTES, {"found": False, "more": False,
-                                           "next_since": None, "count": 0}
+                return 0, 0, {"found": False, "more": False,
+                              "next_since": None, "count": 0}
         if start >= n:
-            return EMPTY_BATCH_BYTES, {"found": True, "more": False,
-                                       "next_since": None, "count": 0}
+            return 0, 0, {"found": True, "more": False,
+                          "next_since": None, "count": 0}
         stop = n
         if 0 < limit < n - start:
             kinds = self.kinds(start, start + limit)
@@ -880,13 +1107,73 @@ class LogView:
                 # everything past the trimmed window is deletes:
                 # serve the tail NOW (PR-6 all-delete-tail rule)
                 stop = n
-        sub = self.slice_packed(start, stop)
-        body = engine_mod.packed_since_bytes(sub, 0)
-        served = np.nonzero(sub.kind[:sub.num_ops] == KIND_ADD)[0]
-        next_since = int(sub.ts[int(served[-1])]) if len(served) \
-            else None
-        return body, {"found": True, "more": stop < n,
-                      "next_since": next_since, "count": stop - start}
+        return start, stop, None
+
+    def window_plan(self, since: int, limit: int):
+        """Zero-copy serving plan for the same window :meth:`window`
+        would serve — ``(plan, missing)``.
+
+        ``plan`` is ``(chunks, total_len, meta)`` when the window lands
+        ENTIRELY on non-quarantined cold parts whose wire sidecars are
+        ready: ``chunks`` is an ordered list of ``("b", bytes)`` literal
+        pieces (batch envelope, inter-segment commas) and
+        ``("f", path, offset, length)`` sidecar file ranges the handler
+        ships with ``os.sendfile``; the assembled bytes are
+        byte-identical to :meth:`window`'s body and ``meta`` matches its
+        meta field for field (``next_since`` resolves from the resident
+        add indexes — no column load).  ``plan`` is None whenever any
+        part is hot, quarantined, or sidecar-less — the buffered path
+        serves those — and ``missing`` then lists the cold segments
+        whose sidecars exist to be built (the caller queues builds; the
+        NEXT pull of this window goes zero-copy).
+
+        Bounds resolution may still pull cold columns through the
+        segment LRU (the trimming scan): what the plan path eliminates
+        is the per-pull unpack → JSON-encode → concat of the body,
+        which dominates catch-up egress cost."""
+        missing: List[_ColdSeg] = []
+        if limit <= 0 or self._single_full_packed() is not None:
+            return None, missing
+        start, stop, early = self._window_bounds(since, limit)
+        if early is not None:
+            return None, missing
+        parts: List[Tuple[_ColdSeg, int, int]] = []
+        for tag, payload, lo, hi in self._overlaps(start, stop):
+            if tag != "cold" or payload.quarantined:
+                return None, missing
+            parts.append((payload, lo, hi))
+        if not parts:
+            return None, missing
+        ready = True
+        for seg, _, _ in parts:
+            if isinstance(seg.wire, WireIndex):
+                continue
+            ready = False
+            if seg.wire is None:
+                missing.append(seg)
+        if not ready:
+            return None, missing
+        chunks: List[tuple] = [("b", WIRE_PREFIX)]
+        total = len(WIRE_PREFIX)
+        for k, (seg, lo, hi) in enumerate(parts):
+            if k:
+                chunks.append(("b", b","))
+                total += 1
+            off, ln = seg.wire.range_of(lo, hi)
+            chunks.append(("f", seg.wire.path, off, ln))
+            total += ln
+        chunks.append(("b", WIRE_SUFFIX))
+        total += len(WIRE_SUFFIX)
+        next_since = None
+        for seg, lo, hi in reversed(parts):
+            mask = (seg.add_pos >= lo) & (seg.add_pos < hi)
+            if mask.any():
+                pos = seg.add_pos[mask]
+                next_since = int(seg.add_ts[mask][int(np.argmax(pos))])
+                break
+        meta = {"found": True, "more": stop < self.length,
+                "next_since": next_since, "count": stop - start}
+        return (chunks, total, meta), missing
 
 
 class OpLog:
@@ -1063,6 +1350,7 @@ class OpLog:
                         os.remove(seg.path)
                     except OSError:
                         pass
+                    drop_wire_sidecars(seg.path)
                 matz_files = list(self._matz_tombs)
                 if self._matz is not None:
                     matz_files.append(os.path.join(
@@ -1729,6 +2017,7 @@ class OpLog:
                 os.remove(seg.path)
             except OSError:
                 pass
+            drop_wire_sidecars(seg.path)
         self._tombs = keep
         self.gc_deferred = len(keep)
 
@@ -1890,6 +2179,10 @@ class OpLog:
             # file, never the corrupt one
             seg.path = fresh.path
             seg.quarantined = False
+            # any wire sidecar belonged to the replaced file: reset to
+            # unprobed so the next cold window rebuilds from the
+            # healthy bytes (and delete the stale pair below)
+            seg.wire = None
             self.repairs += 1
             self._durable_manifest_locked()
             if old_path != path:
@@ -1897,6 +2190,7 @@ class OpLog:
                     os.remove(old_path)
                 except OSError:
                     pass
+                drop_wire_sidecars(old_path)
         self._fire_advance()
         return True
 

@@ -43,6 +43,7 @@ from ..core.operation import Batch, Operation
 from ..obs import flight as flight_mod
 from ..obs import oracle as oracle_mod
 from ..obs import trace as trace_mod
+from .. import oplog as oplog_mod
 from ..oplog import PackedBatch
 from . import snapshot as snapshot_mod
 from . import watch as watch_mod
@@ -185,7 +186,8 @@ class ServedDoc:
         self.chunks_launched = 0
         self._seq = 0
         self._snap = snapshot_mod.derive(doc_id, 0, self.tree,
-                                         stats=self.readcache)
+                                         stats=self.readcache,
+                                         shm=engine.shmcache)
         self._prev_snap: Optional[snapshot_mod.DocSnapshot] = None
         # everything restored/replayed so far is durable (or, for
         # non-durable docs, committed) — background spills may cover it
@@ -399,7 +401,7 @@ class ServedDoc:
         self._prepared_seq += 1
         return self.publish_prepared(snapshot_mod.derive(
             self.doc_id, self._prepared_seq, self.tree,
-            stats=self.readcache))
+            stats=self.readcache, shm=self._engine.shmcache))
 
     def prepare_publish(self) -> snapshot_mod.DocSnapshot:
         """Pipelined commit path, compute half (scheduler thread):
@@ -412,7 +414,8 @@ class ServedDoc:
         monotonicity is all readers rely on)."""
         self._prepared_seq += 1
         return snapshot_mod.derive(self.doc_id, self._prepared_seq,
-                                   self.tree, stats=self.readcache)
+                                   self.tree, stats=self.readcache,
+                                   shm=self._engine.shmcache)
 
     def publish_prepared(self, snap: snapshot_mod.DocSnapshot) -> float:
         """Swap in a :meth:`prepare_publish` snapshot — the
@@ -420,6 +423,7 @@ class ServedDoc:
         commit's fsync (WAL-sync worker, or the scheduler itself on
         the serialized path via :meth:`publish`)."""
         staleness = self._snap.age_s()
+        outgoing = self._snap
         if self._engine.fault is not None:
             # only fault injection ever serves the previous generation
             # (read_view); in production retaining it would double the
@@ -434,6 +438,19 @@ class ServedDoc:
         # commit's fsync resolved, a watcher can never be shown a
         # generation whose fsync could still roll back
         self.watch.notify(snap.seq)
+        # host-shared body tier (serve/shmcache.py): the swap IS the
+        # invalidation — release the outgoing generation's segment
+        # claim off-thread (manifest flock I/O must not ride the
+        # publish path); readers still holding its memoryviews stay
+        # valid by the unlink-under-mmap contract
+        seg_name = outgoing.shm_seg_name
+        if seg_name is not None:
+            shm, maint = self._engine.shmcache, self._engine.maintenance
+            if shm is not None and not (
+                    maint is not None
+                    and maint.enqueue("shmrel", self,
+                                      payload=seg_name)):
+                shm.release(seg_name)
         return staleness
 
     def safe_extent(self) -> int:
@@ -490,6 +507,56 @@ class ServedDoc:
         """Windowed anti-entropy pull (``GET /ops?since=&limit=``) off
         the published snapshot — cluster/antientropy.py's wire."""
         return self._snap.ops_since_window(ts, limit)
+
+    def ops_window_plan(self, since: int, limit: int = 0):
+        """Zero-copy serving plan for a cold catch-up window
+        (oplog.LogView.window_plan; docs/SERVING.md §Zero-copy
+        egress): ``(chunks, total_len, meta)`` with ``meta`` carrying
+        the SAME quoted-sha1 ``etag`` the buffered path serves for
+        these bytes, or None when the window must go buffered (hot
+        rows in range, sendfile disabled, sidecars still building).
+        Sidecars found missing are handed to the maintenance lane
+        here — the NEXT pull of this window goes zero-copy — or built
+        inline when no worker runs.  The returned tuple carries the
+        snapshot the plan was built from as its 4th element: the
+        CALLER must hold it until the send completes, because the
+        pinned view is what keeps every planned segment file (and
+        sidecar — tomb GC deletes both together) alive across a
+        concurrent publish/fold."""
+        sf = self._engine.sendfile_stats
+        if sf is None or limit <= 0:
+            return None
+        snap = self._snap
+        view = snap.view
+        if not hasattr(view, "window_plan"):
+            return None
+        plan, missing = view.window_plan(since, limit)
+        if missing:
+            maint = self._engine.maintenance
+            for seg in missing:
+                seg.wire = "building"
+                if maint is None or not maint.enqueue(
+                        "wire", self, payload=seg):
+                    ok = oplog_mod.ensure_wire_sidecar(seg)
+                    sf.add("sidecar_builds" if ok
+                           else "sidecar_build_failures")
+            if maint is None:
+                plan, missing = view.window_plan(since, limit)
+        if plan is None:
+            sf.add("fallback")
+            return None
+        chunks, total, meta = plan
+        etag = oplog_mod.plan_etag(chunks)
+        if etag is None:
+            sf.add("fallback")
+            return None
+        meta = dict(meta)
+        meta["etag"] = etag
+        return chunks, total, meta, snap
+
+    @property
+    def sendfile_stats(self):
+        return self._engine.sendfile_stats
 
     def snapshot_packed(self) -> bytes:
         return self._snap.checkpoint_bytes()
@@ -583,10 +650,12 @@ class ServingEngine:
                  oplog_dir: Optional[str] = None,
                  readcache: Optional[bool] = None,
                  readcache_windows: Optional[int] = None,
+                 shmcache: Optional[bool] = None,
                  watch_max: Optional[int] = None,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
                  wal_shared: Optional[bool] = None,
+                 wal_sync_backend: Optional[str] = None,
                  pipeline: Optional[bool] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
@@ -611,6 +680,33 @@ class ServingEngine:
             if readcache_windows is not None \
             else _env_int("GRAFT_READCACHE_WINDOWS",
                           snapshot_mod.DEFAULT_WINDOW_LRU)
+        # host-shared encoded-body tier (serve/shmcache.py; ISSUE 17):
+        # off by default — GRAFT_SHMCACHE=1 arms it on a many-process
+        # host so N processes serve ONE copy of each generation's
+        # whole-doc bodies.  GRAFT_READCACHE=0 bypasses both tiers;
+        # construction failure (no POSIX shm) degrades to per-process.
+        if shmcache is None:
+            shmcache = os.environ.get(
+                "GRAFT_SHMCACHE", "0").strip() not in ("", "0")
+        self.shmcache = None
+        if shmcache and self.readcache_enabled:
+            from . import shmcache as shmcache_mod
+            try:
+                self.shmcache = shmcache_mod.ShmBodyCache()
+                self.shmcache.scavenge()
+            except (OSError, AttributeError):
+                self.shmcache = None
+        # zero-copy cold egress (oplog.py wire sidecars; ISSUE 17): on
+        # by default wherever the cascade tiers logs — a catch-up /ops
+        # window that lands entirely on cold segments ships as
+        # os.sendfile ranges over precomputed wire sidecars.
+        # GRAFT_SENDFILE=0 restores the buffered load→encode cold path
+        # (the A/B baseline; wire bytes identical either way).
+        sendfile_on = os.environ.get(
+            "GRAFT_SENDFILE", "1").strip() not in ("", "0")
+        self.sendfile_stats: Optional[Counters] = \
+            Counters() if sendfile_on and self.oplog_hot_ops > 0 \
+            else None
         # delta-push fan-out (serve/watch.py; ISSUE 16): per-doc
         # parked-watcher cap (429 past it), long-poll park budget
         # ceiling, SSE heartbeat cadence
@@ -743,7 +839,11 @@ class ServingEngine:
             self.maintenance = MaintenanceWorker(self)
         if self.pipeline and self.durable_dir is not None \
                 and self.wal_sync == "batch":
-            self.sync_worker = WalSyncWorker(self)
+            # fan-out backend for the group-commit fsync stage
+            # (GRAFT_WAL_SYNC_BACKEND=auto|uring|workers|single;
+            # docs/DURABILITY.md §Sync backends)
+            self.sync_worker = WalSyncWorker(
+                self, backend=wal_sync_backend)
         if self.shared_wal is not None and self.maintenance is not None:
             maint = self.maintenance
             self.shared_wal.set_compact_cb(
@@ -1060,6 +1160,10 @@ class ServingEngine:
                 d.wal.close()
         if self.shared_wal is not None:
             self.shared_wal.close()
+        if self.shmcache is not None:
+            # drop every shared-segment claim this process holds; the
+            # last claimant's release unlinks (serve/shmcache.py)
+            self.shmcache.close()
         if self._own_oplog_dir:
             import shutil
             shutil.rmtree(self.oplog_dir, ignore_errors=True)

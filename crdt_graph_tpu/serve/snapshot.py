@@ -121,13 +121,15 @@ class DocSnapshot:
                  "timestamp", "cursor", "max_depth", "log_length",
                  "log_segments", "committed_at", "_fp", "_sfp",
                  "_stats", "_values_body", "_clock_body", "_etag",
-                 "_win_mu", "_win", "_win_inflight")
+                 "_win_mu", "_win", "_win_inflight", "_shm",
+                 "shm_seg_name")
 
     def __init__(self, doc_id: str, seq: int, view: LogView,
                  values: Tuple[Any, ...], clock: Dict[int, int],
                  replica: int, timestamp: int, cursor: Tuple[int, ...],
                  max_depth: int,
-                 stats: Optional[ReadCacheStats] = None):
+                 stats: Optional[ReadCacheStats] = None,
+                 shm=None):
         self.doc_id = doc_id
         self.seq = seq
         self.view = view
@@ -149,6 +151,13 @@ class DocSnapshot:
         # first reader of each wire shape; one stats object per
         # DOCUMENT outlives the per-generation caches
         self._stats = stats if stats is not None else ReadCacheStats()
+        # host-shared encoded-body tier (serve/shmcache.py; ISSUE 17):
+        # when armed, the two whole-doc bodies below resolve against
+        # ONE shared segment per generation across every process on
+        # the host; ``shm_seg_name`` is this generation's claim,
+        # released by the publish swap that retires it
+        self._shm = shm
+        self.shm_seg_name: Optional[str] = None
         self._values_body: Optional[bytes] = None
         self._clock_body: Optional[bytes] = None
         self._etag: Optional[str] = None
@@ -191,13 +200,43 @@ class DocSnapshot:
     def cache_stats(self) -> ReadCacheStats:
         return self._stats
 
+    def _encode_bodies(self) -> Tuple[bytes, bytes]:
+        """Both whole-doc wire bodies, straight off the encoders —
+        the single source of truth every cache tier stores verbatim
+        (byte-identity across private/shared/uncached is by
+        construction)."""
+        return (json.dumps({"values": self.values}).encode(),
+                json.dumps({"replicas": self.clock_wire()}).encode())
+
+    def _shm_fill(self) -> bool:
+        """Resolve both whole-doc bodies against the host-shared tier
+        (one segment per generation, serve/shmcache.py).  False means
+        tier off or degraded — the caller stays on the process-local
+        path.  ``GRAFT_READCACHE=0`` bypasses this tier too (same
+        ``stats.enabled`` gate as the private cache)."""
+        shm = self._shm
+        if shm is None or not self._stats.enabled:
+            return False
+        got = shm.get_or_publish(self.doc_id, self.state_fingerprint(),
+                                 self._encode_bodies)
+        if got is None:
+            return False
+        self._values_body, self._clock_body, self.shm_seg_name = got
+        return True
+
     def values_body(self) -> bytes:
         """The exact ``GET /docs/{id}`` wire body, encoded at most once
         per published generation (lock-free: a racing first pair of
-        readers may both encode — same bytes, last store wins)."""
+        readers may both encode — same bytes, last store wins).  With
+        the shared tier armed, encoded at most once per HOST — the
+        body is then a memoryview over the shared segment."""
         body = self._values_body
         if body is not None:
             self._stats.hit()
+            return body
+        if self._shm_fill():
+            body = self._values_body
+            self._stats.miss(len(body))
             return body
         body = json.dumps({"values": self.values}).encode()
         self._stats.miss(len(body))
@@ -211,6 +250,10 @@ class DocSnapshot:
         body = self._clock_body
         if body is not None:
             self._stats.hit()
+            return body
+        if self._shm_fill():
+            body = self._clock_body
+            self._stats.miss(len(body))
             return body
         body = json.dumps({"replicas": self.clock_wire()}).encode()
         self._stats.miss(len(body))
@@ -399,7 +442,8 @@ class DocSnapshot:
 
 
 def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree",
-           stats: Optional[ReadCacheStats] = None) -> DocSnapshot:
+           stats: Optional[ReadCacheStats] = None,
+           shm=None) -> DocSnapshot:
     """Build the next snapshot from a just-committed tree.  Called by
     the scheduler thread (the tree's only writer) BEFORE resolving the
     merged requests, so a client's follow-up read always sees its own
@@ -419,4 +463,5 @@ def derive(doc_id: str, seq: int, tree: "engine_mod.TpuTree",
         cursor=tuple(tree.cursor),
         max_depth=tree._max_depth,
         stats=stats,
+        shm=shm,
     )

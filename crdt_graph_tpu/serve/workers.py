@@ -62,6 +62,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import wal as wal_mod
+from ..utils.hostenv import env_int as _env_int
 from .metrics import Histogram, LATENCY_BOUNDS_MS
 
 
@@ -91,21 +92,76 @@ class PendingCommit:
 
 
 class WalSyncWorker(threading.Thread):
-    """The pipeline's fsync stage (module docstring).  One job = one
-    scheduler round's deferred commits; jobs run FIFO at depth 1."""
+    """The pipeline's fsync stage (module docstring), with a pluggable
+    fan-out backend (``GRAFT_WAL_SYNC_BACKEND``; docs/DURABILITY.md
+    §Sync backends):
 
-    def __init__(self, engine):
+    - ``single`` — the serialized baseline: one fsync at a time on
+      this thread, entries resolve in queue order.  A round's ack p99
+      is gated by the SUM of its docs' fsyncs.
+    - ``workers`` — the portable fan-out: entries dispatch to a small
+      thread pool, each doc's ``publish_prepared`` + ticket resolve
+      runs the moment ITS file's fsync lands, not when the round's
+      slowest file does.
+    - ``uring`` — the completion-driven lane: this thread owns one
+      io_uring (utils/uring.py) with many per-doc fsyncs in flight,
+      reaping completions as the kernel posts them.  Zero extra
+      threads; same per-completion resolve as ``workers``.
+    - ``auto`` (default) — ``uring`` where the kernel supports it
+      (probed once), else ``workers``.
+
+    Every backend preserves the ack contract verbatim: nothing
+    resolves or publishes until ITS doc's fsync completed; a failed
+    fsync repairs the WAL tail and hands the doomed commits to the
+    scheduler's rollback (``_fail`` — failure visible in
+    ``_failed_sync`` BEFORE the doc's inflight count drops); the
+    per-doc ``wait_docs_clear`` barrier means one document never has
+    an append and an fsync in flight at once, which is exactly what
+    makes the out-of-band ``Wal.sync_begin``/``sync_end`` split safe.
+    Shared-stream engines (``GRAFT_WAL_SHARED``) pin ``single``: one
+    stream has one fsync per round — there is nothing to fan out."""
+
+    def __init__(self, engine, backend: Optional[str] = None):
         super().__init__(name="crdt-wal-sync", daemon=True)
         self.engine = engine
         self._cv = threading.Condition()
         self._q: collections.deque = collections.deque()
-        self._executing = False
+        # entries handed to a lane (single-loop iteration, pool, or
+        # ring) and not yet finished/failed — the quiescence count
+        # idle()/wait_idle/flush key off (replaces the old boolean
+        # _executing: a fan-out lane can hold many at once)
+        self._lane = 0
         self._stop_req = False
         self.crashed = False
-        # telemetry (crdt_sched_pipeline_* prom families)
+        self._pool: Optional[_FsyncPool] = None
+        self._ring = None
+        self.backend_requested = backend if backend is not None \
+            else wal_mod.sync_backend_from_env()
+        if self.backend_requested not in wal_mod.SYNC_BACKENDS:
+            raise ValueError(
+                f"sync backend {self.backend_requested!r} not in "
+                f"{wal_mod.SYNC_BACKENDS}")
+        self.backend = self._resolve_backend(self.backend_requested)
+        # telemetry (crdt_sched_pipeline_* / crdt_wal_sync_* families)
         self.jobs_done = 0
         self.commits_synced = 0
         self.commits_shed = 0
+
+    def _resolve_backend(self, requested: str) -> str:
+        if self.engine.shared_wal is not None:
+            # one stream = one fsync per round; nothing to fan out
+            return "single"
+        if requested in ("auto", "uring"):
+            from ..utils import uring as uring_mod
+            if uring_mod.available():
+                return "uring"
+            if requested == "uring":
+                # explicit ask the kernel can't honor: fall back,
+                # counted — never silent (the stats pair
+                # backend_requested/backend shows the downgrade too)
+                self.engine.counters.add("wal_sync_uring_unavailable")
+            return "workers"
+        return requested
 
     # -- scheduler-side API ------------------------------------------------
 
@@ -124,19 +180,31 @@ class WalSyncWorker(threading.Thread):
                 e.doc._sync_inflight += 1
                 self._q.append(e)
             self._cv.notify_all()
+        ring = self._ring
+        if ring is not None:
+            # the uring owner parks inside io_uring_enter, not on the
+            # condition — bump its eventfd so the new entries dispatch
+            # immediately instead of at the next completion
+            ring.wake()
 
     def idle(self) -> bool:
-        # under the condition: the run loop's pop→executing handoff is
+        # under the condition: the run loop's pop→lane handoff is
         # atomic w.r.t. lock holders, but a lock-free read could land
         # in the gap and report quiescence over an executing batch —
         # matz pickup and flush() key real invariants off this
         with self._cv:
-            return not (self._q or self._executing)
+            return not (self._q or self._lane)
 
     @property
     def inflight(self) -> int:
         with self._cv:
-            return len(self._q) + (1 if self._executing else 0)
+            return len(self._q) + self._lane
+
+    def sync_inflight(self) -> int:
+        """Entries currently in the fan-out lane (dispatched, fsync
+        not yet completed) — the ``crdt_wal_sync_inflight`` gauge."""
+        with self._cv:
+            return self._lane
 
     def wait_idle(self, timeout: Optional[float] = None) -> bool:
         """Block until no entry is queued or executing.  False on
@@ -144,7 +212,7 @@ class WalSyncWorker(threading.Thread):
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         with self._cv:
-            while self._q or self._executing:
+            while self._q or self._lane:
                 if self.crashed:
                     return False
                 remaining = 0.25 if deadline is None \
@@ -180,85 +248,224 @@ class WalSyncWorker(threading.Thread):
         with self._cv:
             self._stop_req = True
             self._cv.notify_all()
+        ring = self._ring
+        if ring is not None:
+            ring.wake()
         if self.is_alive():
             self.join(timeout)
 
     def stats(self) -> Dict[str, Any]:
         with self._cv:
-            inflight = len(self._q) + (1 if self._executing else 0)
+            inflight = len(self._q) + self._lane
+            lane = self._lane
         return {"jobs_done": self.jobs_done,
                 "commits_synced": self.commits_synced,
                 "commits_shed": self.commits_shed,
                 "inflight": inflight,
+                # sync-backend fan-out (docs/DURABILITY.md §Sync
+                # backends): which lane is live, what was asked for,
+                # and how many fsyncs it holds in flight right now
+                "backend": self.backend,
+                "backend_requested": self.backend_requested,
+                "sync_inflight": lane,
                 "crashed": self.crashed}
 
     # -- worker loop -------------------------------------------------------
 
     def run(self) -> None:
         try:
-            while True:
-                with self._cv:
-                    while not self._q and not self._stop_req:
-                        self._cv.wait(0.25)
-                    if not self._q:
-                        break               # stop requested, drained
-                    # take everything queued: per-doc mode fsyncs and
-                    # resolves entry by entry (arrivals during the
-                    # sweep wait one turn); shared mode covers the
-                    # whole batch with its ONE stream fsync
-                    entries = list(self._q)
-                    self._q.clear()
-                    self._executing = True
+            if self.backend == "uring":
+                from ..utils import uring as uring_mod
                 try:
-                    self._run_job(entries)
-                except wal_mod.CrashPoint:
-                    # mark BEFORE the finally clears _executing: a
-                    # barrier waiter woken by that clear must see the
-                    # crash, never quiescence over a dead lane
-                    self.crashed = True
-                    raise
-                except Exception as e:  # noqa: BLE001 — thread boundary
-                    # a bug in the sync stage must not wedge the
-                    # pipeline: shed what the batch hadn't resolved
-                    # (the scheduler rolls back and resolves tickets)
-                    self._fail([x for x in entries
-                                if not x.resolved], e)
+                    ring = uring_mod.FsyncRing(entries=_env_int(
+                        "GRAFT_WAL_URING_ENTRIES", 256))
+                except (uring_mod.UringUnavailable, OSError):
+                    # the construction-time probe passed but setup
+                    # failed now (fd limits, cgroup memlock): degrade
+                    # to the portable lane, counted — never silent
+                    self.backend = "workers"
+                    self.engine.counters.add(
+                        "wal_sync_uring_unavailable")
+            if self.backend == "uring":
+                self._ring = ring
+                try:
+                    self._run_uring(ring)
                 finally:
-                    with self._cv:
-                        self._executing = False
-                        self._cv.notify_all()
+                    self._ring = None
+                    ring.close()
+            else:
+                if self.backend == "workers":
+                    self._pool = _FsyncPool(self, max(1, min(
+                        64, _env_int("GRAFT_WAL_SYNC_WORKERS", 8))))
+                self._run_queue()
         except wal_mod.CrashPoint:
             # simulated kill (GRAFT_CRASH_POINT, in-process mode): die
             # like a SIGKILL — resolve nothing, clean up nothing; the
-            # flag below makes the scheduler die at its next loop
-            # check (whole-process death shape).
-            sched = self.engine.scheduler
-            sched._sync_crashed = True
-            with sched.cond:
-                sched.cond.notify_all()
-            with self._cv:
-                self._cv.notify_all()
+            # flag makes the scheduler die at its next loop check
+            # (whole-process death shape).
+            self._note_crash()
             return
+
+    def _note_crash(self) -> None:
+        """A lane thread hit a :class:`~crdt_graph_tpu.wal.CrashPoint`
+        — mark the whole pipeline dead exactly like the single-lane
+        epilogue always did (crashed BEFORE any waiter wakes: no
+        quiescence over a dead lane)."""
+        self.crashed = True
+        sched = self.engine.scheduler
+        sched._sync_crashed = True
+        with sched.cond:
+            sched.cond.notify_all()
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- queue-driven lanes (single / workers / shared-stream) ------------
+
+    def _run_queue(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stop_req \
+                        and not self.crashed:
+                    self._cv.wait(0.25)
+                if self.crashed:
+                    return          # a pool thread died on a crash
+                    # site; _note_crash already ran its epilogue
+                if not self._q:
+                    break           # stop requested, drained
+                # take everything queued: the single lane fsyncs and
+                # resolves entry by entry (arrivals during the sweep
+                # wait one turn); the workers lane dispatches each to
+                # the pool; shared mode covers the whole batch with
+                # its ONE stream fsync
+                entries = list(self._q)
+                self._q.clear()
+                self._lane += len(entries)
+            try:
+                self._run_job(entries)
+            except wal_mod.CrashPoint:
+                # mark BEFORE the finally wakes waiters: a barrier
+                # waiter woken by that notify must see the crash,
+                # never quiescence over a dead lane
+                self.crashed = True
+                raise
+            except Exception as e:  # noqa: BLE001 — thread boundary
+                # a bug in the sync stage must not wedge the
+                # pipeline: shed what the batch hadn't resolved
+                # (the scheduler rolls back and resolves tickets)
+                self._fail([x for x in entries
+                            if not x.resolved], e)
+            finally:
+                with self._cv:
+                    self._cv.notify_all()
+        # stop path: pool entries may still be in flight — their acks
+        # must resolve before the lane exits (engine.close contract)
+        with self._cv:
+            while self._lane and not self.crashed:
+                self._cv.wait(0.25)
+        if self._pool is not None:
+            self._pool.stop()
 
     def _run_job(self, entries: List[PendingCommit]) -> None:
         if self.engine.shared_wal is not None:
             self._sync_shared(entries)
+        elif self._pool is not None:
+            for entry in entries:
+                self._pool.submit(entry)
         else:
-            self._sync_perdoc(entries)
+            for entry in entries:
+                self._sync_one(entry)
         self.jobs_done += 1
 
-    def _sync_perdoc(self, entries: List[PendingCommit]) -> None:
-        for entry in entries:
-            wal_mod.maybe_crash("ack-pre-fsync")
-            t0 = time.perf_counter()
-            try:
-                entry.doc.wal.sync()
-            except OSError as e:
-                self._fail([entry], e)
+    def _sync_one(self, entry: PendingCommit) -> None:
+        """One entry's whole durability half, synchronously: crash
+        sites, fsync, failure shed, finish.  The unit both the single
+        lane (serially, on the worker thread) and the workers lane
+        (concurrently, on pool threads) execute."""
+        wal_mod.maybe_crash("ack-pre-fsync")
+        t0 = time.perf_counter()
+        try:
+            entry.doc.wal.sync()
+        except OSError as e:
+            self._fail([entry], e)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        wal_mod.maybe_crash("post-fsync-pre-publish")
+        self._finish(entry, ms, t0)
+
+    # -- completion-driven lane (io_uring) --------------------------------
+
+    def _run_uring(self, ring) -> None:
+        """Ring-owner loop: drain the queue into in-flight fsync SQEs,
+        park in ``io_uring_enter`` until completions (or a submit-side
+        wakeup) land, resolve each doc THE MOMENT its own durability
+        completed.  Crash sites fire per entry at dispatch
+        (ack-pre-fsync) and per completion (post-fsync-pre-publish) —
+        the same sites, same order per doc, as the serial lane."""
+        pending: Dict[int, tuple] = {}
+        token = 0
+        while True:
+            with self._cv:
+                entries = list(self._q)
+                self._q.clear()
+                self._lane += len(entries)
+                stop = self._stop_req
+            for i, entry in enumerate(entries):
+                if ring.inflight >= ring.max_inflight:
+                    # ring at capacity: requeue the tail (front, in
+                    # order) and reap before submitting more
+                    with self._cv:
+                        self._q.extendleft(reversed(entries[i:]))
+                        self._lane -= len(entries) - i
+                    entries = entries[:i]
+                    break
+                token += 1
+                self._uring_dispatch(ring, entry, token, pending)
+            if entries:
+                self.jobs_done += 1     # one dispatch burst ≈ one job
+            if not pending and stop:
+                with self._cv:
+                    if not self._q:
+                        return      # drained: every ack resolved
                 continue
-            ms = (time.perf_counter() - t0) * 1e3
-            wal_mod.maybe_crash("post-fsync-pre-publish")
-            self._finish(entry, ms, t0)
+            # block only when nothing was just dispatched — after a
+            # dispatch burst, poll so a freshly queued round is not
+            # stuck behind the oldest in-flight fsync
+            for tok, res in ring.wait_completions(
+                    block=not entries):
+                self._uring_complete(tok, res, pending)
+
+    def _uring_dispatch(self, ring, entry: PendingCommit, token: int,
+                        pending: Dict[int, tuple]) -> None:
+        wal_mod.maybe_crash("ack-pre-fsync")
+        try:
+            fd = entry.doc.wal.sync_begin()
+        except OSError as e:
+            self._fail([entry], e)
+            return
+        t0 = time.perf_counter()
+        pending[token] = (entry, t0)
+        try:
+            ring.submit_fsync(fd, token)
+        except OSError as e:
+            # submission itself failed: same contract as a failed
+            # fsync — repair the tail, shed the commit
+            pending.pop(token, None)
+            try:
+                entry.doc.wal.sync_end(e.errno or 5, 0.0)
+            except OSError as e2:
+                self._fail([entry], e2)
+
+    def _uring_complete(self, token: int, res: int,
+                        pending: Dict[int, tuple]) -> None:
+        entry, t0 = pending.pop(token)
+        ms = (time.perf_counter() - t0) * 1e3
+        try:
+            entry.doc.wal.sync_end(-res if res < 0 else 0, ms)
+        except OSError as e:
+            self._fail([entry], e)
+            return
+        wal_mod.maybe_crash("post-fsync-pre-publish")
+        self._finish(entry, ms, t0)
 
     def _sync_shared(self, entries: List[PendingCommit]) -> None:
         wal_mod.maybe_crash("ack-pre-fsync")
@@ -302,7 +509,6 @@ class WalSyncWorker(threading.Thread):
             ct.total_ms + queued_ms + fsync_ms
             + (time.perf_counter() - t1) * 1e3, 3)
         doc.commit_ms.observe(ct.total_ms)
-        self.commits_synced += 1
         self.engine.record_commit(doc, ct)
         doc.note_durable(entry.log_len)
         # the safe extent just advanced: a spill task that was capped
@@ -315,6 +521,9 @@ class WalSyncWorker(threading.Thread):
         entry.resolved = True
         with self._cv:
             doc._sync_inflight -= 1
+            self._lane -= 1
+            self.commits_synced += 1   # under the cv: pool threads
+            # finish concurrently and += is not atomic across threads
             self._cv.notify_all()
 
     def _fail(self, entries: List[PendingCommit], e: Exception) -> None:
@@ -322,7 +531,6 @@ class WalSyncWorker(threading.Thread):
         owner may roll the merges back, and the tickets resolve AFTER
         the rollback so a client's error response never races a log
         still holding its shed ops."""
-        self.commits_shed += len(entries)
         for entry in entries:
             entry.error = e
             entry.resolved = True
@@ -339,12 +547,73 @@ class WalSyncWorker(threading.Thread):
         with self._cv:
             for entry in entries:
                 entry.doc._sync_inflight -= 1
+            self._lane -= len(entries)
+            self.commits_shed += len(entries)
             self._cv.notify_all()
         if sched.stopped:
             # a stopping scheduler will never service these — resolve
             # the tickets now (no rollback possible; the engine is
             # closing) so no handler thread blocks through close()
             sched.abandon_failed_sync()
+
+
+class _FsyncPool:
+    """The ``workers`` sync backend's thread pool: a shared FIFO of
+    :class:`PendingCommit` entries, each executed by
+    :meth:`WalSyncWorker._sync_one` on whichever pool thread picks it
+    up — so every document's publish + resolve happens the moment ITS
+    fsync lands.  Per-doc safety needs no pool-side ordering: the
+    scheduler's ``wait_docs_clear`` barrier guarantees at most one
+    entry per document is in flight anywhere in the lane."""
+
+    def __init__(self, worker: WalSyncWorker, n_threads: int):
+        self.worker = worker
+        self._cv = threading.Condition()
+        self._q: collections.deque = collections.deque()
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._run,
+                             name=f"crdt-wal-sync-{i}", daemon=True)
+            for i in range(n_threads)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, entry: PendingCommit) -> None:
+        with self._cv:
+            self._q.append(entry)
+            self._cv.notify()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def _run(self) -> None:
+        w = self.worker
+        while True:
+            with self._cv:
+                while not self._q and not self._stop \
+                        and not w.crashed:
+                    self._cv.wait(0.25)
+                if w.crashed:
+                    return      # simulated process death: abandon
+                    # the rest, exactly like the serial lane does
+                if not self._q:
+                    return      # stop requested and drained
+                entry = self._q.popleft()
+            try:
+                w._sync_one(entry)
+            except wal_mod.CrashPoint:
+                # a crash site fired on this pool thread: same
+                # whole-process-death shape as the serial lane
+                w._note_crash()
+                return
+            except Exception as e:  # noqa: BLE001 — thread boundary
+                if not entry.resolved:
+                    w._fail([entry], e)
 
 
 class MaintenanceWorker(threading.Thread):
@@ -535,6 +804,24 @@ class MaintenanceWorker(threading.Thread):
             # checksum sweep + quarantine + peer repair — numpy/file/
             # HTTP I/O only, same no-JAX lane contract as the rest
             doc.run_scrub()
+        elif kind == "shmrel":
+            # publish-swap retirement of an outgoing generation's
+            # shared-segment claim (serve/shmcache.py): manifest flock
+            # I/O, deliberately off the publish/scheduler threads
+            shm = self.engine.shmcache
+            if shm is not None:
+                shm.release(payload)
+        elif kind == "wire":
+            # zero-copy egress sidecar build (oplog.py; docs/SERVING.md
+            # §Zero-copy egress): one unpack+encode per SEALED segment,
+            # queued by the first cold window that wanted it — pure
+            # file I/O + JSON encode, off the request threads
+            from .. import oplog as oplog_mod
+            sf = self.engine.sendfile_stats
+            ok = oplog_mod.ensure_wire_sidecar(payload)
+            if sf is not None:
+                sf.add("sidecar_builds" if ok
+                       else "sidecar_build_failures")
 
     # -- spill policies (ISSUE 12 satellite) -------------------------------
 

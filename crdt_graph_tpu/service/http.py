@@ -86,6 +86,7 @@ reading the body.  Passing an explicit ``DocumentStore`` to
 from __future__ import annotations
 
 import json
+import os
 import re
 import socket
 import threading
@@ -182,6 +183,88 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             if code != 304 and len(body):
                 self.wfile.write(body if isinstance(body, memoryview)
                                  else memoryview(body))
+
+        def _serve_ops_plan(self, doc, plan) -> bool:
+            """Ship a zero-copy ``/ops`` window (docs/SERVING.md
+            §Zero-copy egress): the plan's literal pieces go out with
+            ``sendall`` and its sidecar file ranges with
+            ``os.sendfile`` straight from page cache to socket — the
+            window body is never materialized in this process.  The
+            bytes on the wire, the ``ETag``, the ``X-Since-*`` resume
+            headers, and the 304 behavior are IDENTICAL to the
+            buffered path.  Returns True when the response was handled
+            (200, 304, or a died-mid-stream connection), False when
+            the caller should fall back to buffered (a planned sidecar
+            vanished before any byte was sent)."""
+            chunks, total, meta, snap = plan
+            hdrs = {
+                SINCE_FOUND_HEADER: "1" if meta["found"] else "0",
+                SINCE_MORE_HEADER: "1" if meta["more"] else "0",
+            }
+            if meta["next_since"] is not None:
+                hdrs[SINCE_NEXT_HEADER] = str(meta["next_since"])
+            hdrs["ETag"] = meta["etag"]
+            if etag_matches(self.headers.get("If-None-Match"),
+                            meta["etag"]):
+                if hasattr(doc, "readcache"):
+                    doc.readcache.served_304()
+                self._send_raw(304, b"", headers=hdrs)
+                return True
+            sf = getattr(doc, "sendfile_stats", None)
+            # open every planned file BEFORE the status line goes out:
+            # an open failure here still has the buffered fallback
+            fds: dict = {}
+            try:
+                for c in chunks:
+                    if c[0] == "f" and c[1] not in fds:
+                        fds[c[1]] = os.open(c[1], os.O_RDONLY)
+            except OSError:
+                for fd in fds.values():
+                    os.close(fd)
+                if sf is not None:
+                    sf.add("fallback")
+                return False
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(total))
+                for k, v in hdrs.items():
+                    self.send_header(k, v)
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                self.end_headers()
+                # drain the handler's buffered writer before touching
+                # the raw socket — header bytes must precede body bytes
+                self.wfile.flush()
+                out = self.connection.fileno()
+                file_bytes = 0
+                for c in chunks:
+                    if c[0] == "b":
+                        self.connection.sendall(c[1])
+                        continue
+                    _, path, off, remaining = c
+                    fd = fds[path]
+                    while remaining:
+                        sent = os.sendfile(out, fd, off, remaining)
+                        if sent == 0:
+                            raise BrokenPipeError(
+                                "client closed during sendfile")
+                        off += sent
+                        remaining -= sent
+                        file_bytes += sent
+                if sf is not None:
+                    sf.add("windows")
+                    sf.add("file_bytes", file_bytes)
+            except (BrokenPipeError, ConnectionResetError,
+                    ConnectionAbortedError, OSError):
+                # headers already went out: the response cannot be
+                # retried on this connection — kill it
+                self.close_connection = True
+            finally:
+                for fd in fds.values():
+                    os.close(fd)
+                del snap   # held until here: pins the planned files
+            return True
 
         def _route(self) -> Tuple[Optional[str], str, dict]:
             url = urlparse(self.path)
@@ -541,6 +624,18 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 # X-Since-* headers — the body stays a plain wire
                 # batch either way (engine.packed_since_window)
                 try:
+                    # zero-copy fast path (ISSUE 17): a catch-up
+                    # window landing entirely on cold segments with
+                    # ready wire sidecars ships as os.sendfile ranges
+                    # — byte-, header-, and ETag-identical to the
+                    # buffered branch below, which remains the answer
+                    # for hot/mixed windows (and the A/B baseline
+                    # under GRAFT_SENDFILE=0)
+                    if limit > 0 and hasattr(doc, "ops_window_plan"):
+                        plan = doc.ops_window_plan(since, limit)
+                        if plan is not None \
+                                and self._serve_ops_plan(doc, plan):
+                            return
                     if limit > 0 and hasattr(doc, "ops_since_window"):
                         body, meta = doc.ops_since_window(since, limit)
                         hdrs = {

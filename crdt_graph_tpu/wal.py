@@ -95,6 +95,13 @@ PIPELINE_ONLY_SITES = ("pre-queue-fsync", "mid-bg-fold")
 
 SYNC_MODES = ("commit", "batch", "off")
 
+# group-commit fan-out backends (serve/workers.py; docs/DURABILITY.md
+# §Sync backends): "single" = the serialized one-fsync-at-a-time lane
+# (the A/B baseline), "workers" = the portable threaded fan-out,
+# "uring" = completion-driven io_uring submission (utils/uring.py),
+# "auto" = uring where the kernel supports it, else workers
+SYNC_BACKENDS = ("auto", "uring", "workers", "single")
+
 
 class WalError(Exception):
     """The WAL is corrupt past the tolerated torn tail (a checksum
@@ -520,6 +527,47 @@ class Wal:
             self.fsyncs += 1
             self._histogram().observe(
                 (time.perf_counter() - t0) * 1e3)
+
+    # -- out-of-band sync (completion-driven lane; serve/workers.py) -------
+    #
+    # The io_uring backend fsyncs the fd from a ring instead of calling
+    # os.fsync inline, so the durability bookkeeping splits in two:
+    # sync_begin hands out the fd (flushing userspace buffers so the
+    # kernel sees every appended byte), sync_end lands the SAME barrier
+    # advance / failure repair :meth:`sync` would have.  Safe because
+    # the per-doc pipeline barrier guarantees append and fsync never
+    # overlap for one document: between begin and end nothing mutates
+    # ``_size`` or reopens the handle, so completing the fsync at
+    # ``_synced_size = _size`` is exact.
+
+    def sync_begin(self) -> int:
+        """Flush and expose the fd for an externally-driven fsync.
+        Same failure contract as :meth:`sync`: an OSError here repairs
+        back to the durable barrier and propagates (the commit sheds)."""
+        with self._mu:
+            try:
+                f = self._open_locked()
+                f.flush()
+                return f.fileno()
+            except OSError:
+                self.errors += 1
+                self._repair_locked(self._synced_size)
+                raise
+
+    def sync_end(self, err: int, ms: float) -> None:
+        """Land an out-of-band fsync's result: ``err`` is 0 on success
+        or a positive errno.  Success advances the durable barrier and
+        books the fsync exactly like :meth:`sync`; failure repairs the
+        unsynced tail away and raises the OSError the shed path
+        expects."""
+        with self._mu:
+            if err:
+                self.errors += 1
+                self._repair_locked(self._synced_size)
+                raise OSError(err, os.strerror(err))
+            self._synced_size = self._size
+            self.fsyncs += 1
+            self._histogram().observe(ms)
 
     # -- truncation (spill/fold watermark) ---------------------------------
 
@@ -1165,3 +1213,13 @@ def sync_mode_from_env(default: str = "batch") -> str:
     """The ``GRAFT_WAL_SYNC`` knob, validated."""
     mode = os.environ.get("GRAFT_WAL_SYNC", default).strip() or default
     return mode if mode in SYNC_MODES else default
+
+
+def sync_backend_from_env(default: str = "auto") -> str:
+    """The ``GRAFT_WAL_SYNC_BACKEND`` knob, validated (``SYNC_BACKENDS``;
+    resolution of ``auto`` — and of an explicit ``uring`` the kernel
+    cannot honor — happens in serve/workers.py where the fallback is
+    counted, never silent)."""
+    backend = os.environ.get("GRAFT_WAL_SYNC_BACKEND",
+                             default).strip() or default
+    return backend if backend in SYNC_BACKENDS else default

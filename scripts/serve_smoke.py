@@ -466,9 +466,128 @@ def run_fleet(n_servers: int = 3, n_docs: int = 2) -> dict:
     return summary
 
 
+def _fleet_proc_worker() -> None:
+    """One member of the ``--fleet-procs`` smoke (child process;
+    internal entry point).  Applies the SAME deterministic workload as
+    every sibling — converged state ⇒ identical fingerprints ⇒ the
+    host-shared segment names agree without coordination — reading the
+    whole-doc body once per generation, with a marker-file barrier so
+    no member retires generation g before every member has claimed it
+    (that is what makes the miss/hit ledger exact, not statistical)."""
+    from crdt_graph_tpu.codec import json_codec
+    from crdt_graph_tpu.core.operation import Add, Batch
+    from crdt_graph_tpu.serve import ServingEngine
+
+    bdir = os.environ["SMOKE_BARRIER_DIR"]
+    n_procs = int(os.environ["SMOKE_PROCS"])
+    gens = int(os.environ["SMOKE_GENS"])
+    eng = ServingEngine(oplog_hot_ops=8, shmcache=True)
+    assert eng.shmcache is not None, "shm tier failed to arm"
+    fps = []
+    anchor, counter = 0, 0
+    for g in range(gens):
+        ops = []
+        for _ in range(6):
+            counter += 1
+            t = (1 << 32) + counter
+            ops.append(Add(t, (anchor,), counter & 0xFF))
+            anchor = t
+        ok, _ = eng.submit("smoke", json_codec.dumps(Batch(tuple(ops))))
+        assert ok, f"gen {g} rejected"
+        snap = eng.get("smoke").read_view()
+        bytes(snap.values_body())
+        assert snap.shm_seg_name is not None, f"gen {g} not shared"
+        fps.append(snap.state_fingerprint())
+        # barrier: claim logged, wait for the whole fleet before any
+        # member's next publish can retire this generation
+        with open(os.path.join(bdir, f"g{g}.{os.getpid()}"), "w"):
+            pass
+        deadline = time.time() + 60
+        while sum(1 for f in os.listdir(bdir)
+                  if f.startswith(f"g{g}.")) < n_procs:
+            if time.time() > deadline:
+                raise SystemExit(f"barrier timeout at gen {g}")
+            time.sleep(0.02)
+    stats = eng.shmcache.stats.snapshot()
+    eng.close()
+    print(json.dumps({"stats": stats, "fps": fps}), flush=True)
+
+
+def run_fleet_procs(n_procs: int = 3, gens: int = 4) -> dict:
+    """The cross-PROCESS shared-memory smoke (ISSUE 17; docs/SERVING.md
+    §Shared-memory body cache): N real OS processes converge on the
+    same document and serve its encoded body out of ONE shm segment
+    per generation.  Exact ledger, asserted per generation across the
+    fleet: misses +1 (one encode on the whole host), hits +(N-1)
+    (everyone else attaches), zero degradations, identical
+    fingerprints, and zero leaked segments after every member exits."""
+    import shutil
+    import subprocess
+    import tempfile
+    import uuid
+
+    assert n_procs >= 3, "the contract needs at least three processes"
+    ns = f"smoke{uuid.uuid4().hex[:10]}"
+    bdir = tempfile.mkdtemp(prefix="graft-shm-smoke-")
+    env = dict(os.environ)
+    env.update({"GRAFT_SHMCACHE_NS": ns, "SMOKE_BARRIER_DIR": bdir,
+                "SMOKE_PROCS": str(n_procs), "SMOKE_GENS": str(gens)})
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--fleet-proc-worker"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for _ in range(n_procs)]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=180)
+            assert p.returncode == 0, \
+                f"worker died rc={p.returncode}: {stderr[-2000:]}"
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(bdir, ignore_errors=True)
+    # converged: every member saw the identical generation chain
+    for got in outs[1:]:
+        assert got["fps"] == outs[0]["fps"], "fleet diverged"
+    # the exact ledger: gens encodes on the host, everything else
+    # attached, nobody fell back to the process-local path
+    misses = sum(o["stats"]["misses"] for o in outs)
+    hits = sum(o["stats"]["hits"] for o in outs)
+    failed = sum(o["stats"]["attach_failed"] for o in outs)
+    assert misses == gens, (misses, gens)
+    assert hits == gens * (n_procs - 1), (hits, gens, n_procs)
+    assert failed == 0, f"{failed} degraded attaches"
+    # every worker pulled its weight (each gen: one miss XOR one hit)
+    for o in outs:
+        st = o["stats"]
+        assert st["misses"] + st["hits"] == gens, st
+    # nothing leaked past the last exit (manifest file aside)
+    leaked = [f for f in os.listdir("/dev/shm")
+              if ns in f and not f.endswith(".manifest")] \
+        if os.path.isdir("/dev/shm") else []
+    assert not leaked, f"leaked shm segments: {leaked}"
+    try:
+        os.unlink(os.path.join("/dev/shm", f"graftshm-{ns}.manifest"))
+    except OSError:
+        pass
+    return {"procs": n_procs, "gens": gens, "misses": misses,
+            "hits": hits, "shared_bytes": sum(
+                o["stats"]["shared_bytes"] for o in outs)}
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    if "--fleet" in argv:
+    if "--fleet-proc-worker" in argv:
+        _fleet_proc_worker()
+        sys.exit(0)
+    if "--fleet-procs" in argv:
+        i = argv.index("--fleet-procs")
+        n = int(argv[i + 1]) if len(argv) > i + 1 else 3
+        out = run_fleet_procs(n_procs=n)
+    elif "--fleet" in argv:
         i = argv.index("--fleet")
         n = int(argv[i + 1]) if len(argv) > i + 1 else 3
         out = run_fleet(n_servers=n)

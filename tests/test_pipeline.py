@@ -162,15 +162,20 @@ def test_failed_pipelined_fsync_rolls_back_both_rounds(tmp_path):
     vals = doc.snapshot()
 
     real_sync = doc.wal.sync
+    real_sync_begin = doc.wal.sync_begin
     release = threading.Event()
 
-    def blocked_sync():
+    def blocked_sync(*_a, **_k):
         # hold round N's fsync open until round N+1 has computed,
         # then fail it — the deterministic cross-round overlap
         release.wait(20)
         raise OSError(28, "No space left on device")
 
+    # completion-driven lanes enter the WAL at sync_begin(); the single
+    # and threaded lanes call sync() — block both seams so the injected
+    # failure fires regardless of GRAFT_WAL_SYNC_BACKEND
     doc.wal.sync = blocked_sync
+    doc.wal.sync_begin = blocked_sync
     results = {}
 
     def writer(name, ops):
@@ -199,6 +204,7 @@ def test_failed_pipelined_fsync_rolls_back_both_rounds(tmp_path):
         time.sleep(0.01)
     assert doc.tree.log_length == 12
     doc.wal.sync = real_sync
+    doc.wal.sync_begin = real_sync_begin
     release.set()
     ta.join(30)
     tb.join(30)

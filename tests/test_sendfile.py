@@ -1,0 +1,196 @@
+"""Zero-copy cold egress (ISSUE 17; docs/SERVING.md §Zero-copy
+egress): ``/ops`` windows that land entirely on sealed cold segments
+are served by ``os.sendfile`` straight from the wire sidecars.  Pins
+BYTE-identity with the buffered path (body, ETag, X-Since-* headers,
+304s) across a full resumable window chain, the ``GRAFT_SENDFILE=0``
+A/B baseline, sidecar cleanup on ephemeral close, and the
+crdt_sendfile_* prom family gating.
+"""
+import os
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu import oplog as oplog_mod
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.serve import ServingEngine
+from crdt_graph_tpu.service.http import make_server
+
+OFF = 2**32
+
+
+def chain(rid, n, counter0=0, anchor=0):
+    ops, prev = [], anchor
+    for i in range(n):
+        t = rid * OFF + counter0 + i + 1
+        ops.append(Add(t, (prev,), (counter0 + i) & 0xFF))
+        prev = t
+    return ops, prev
+
+
+def _fill(eng, doc="d", rounds=30):
+    """Enough sealed cold segments for several all-cold windows."""
+    anchor = 0
+    for i in range(rounds):
+        ops, anchor = _chain_round(i, anchor)
+        ok, _ = eng.submit(doc, json_codec.dumps(Batch(tuple(ops))))
+        assert ok, i
+
+
+def _chain_round(i, anchor):
+    return chain(1, 4, counter0=i * 4, anchor=anchor)
+
+
+@pytest.fixture()
+def served():
+    eng = ServingEngine(oplog_hot_ops=8)
+    assert eng.sendfile_stats is not None, "GRAFT_SENDFILE default-on"
+    _fill(eng)
+    srv = make_server(port=0, store=eng)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    port = srv.server_address[1]
+
+    def get(path, headers=None):
+        c = HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", path, headers=headers or {})
+        r = c.getresponse()
+        body = r.read()
+        hdrs = {k.lower(): v for k, v in r.getheaders()}
+        c.close()
+        return r.status, body, hdrs
+
+    yield eng, get
+    srv.shutdown()
+    eng.close()
+
+
+def _await_sendfile(eng, get, path):
+    """First pull is buffered (sidecars build on the maintenance
+    lane); poll until a window actually went out via sendfile."""
+    st, body, hdrs = get(path)
+    assert st == 200
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        st2, body2, hdrs2 = get(path)
+        assert st2 == 200
+        if eng.sendfile_stats.get("windows"):
+            return (body, hdrs), (body2, hdrs2)
+        time.sleep(0.1)
+    pytest.fail(f"sendfile never served: "
+                f"{eng.sendfile_stats.snapshot()}")
+
+
+def test_zero_copy_window_is_byte_identical(served):
+    eng, get = served
+    (b0, h0), (b1, h1) = _await_sendfile(
+        eng, get, "/docs/d/ops?since=0&limit=16")
+    assert b1 == b0, "zero-copy bytes != buffered bytes"
+    assert h1["etag"] == h0["etag"]
+    assert h1["content-length"] == str(len(b1))
+    assert eng.sendfile_stats.get("file_bytes") > 0
+
+
+def test_window_chain_identical_to_buffered_truth(served):
+    """Walk the whole resumable chain (since -> next_since): every
+    window's body, ETag, more-flag and cursor match the buffered
+    snapshot path exactly — the in-memory truth the plan path must
+    never diverge from."""
+    eng, get = served
+    _await_sendfile(eng, get, "/docs/d/ops?since=0&limit=16")
+    snap = eng.get("d").snapshot_view()
+    since = 0
+    for _ in range(100):
+        bbody, bmeta = snap.ops_since_window(since, 16)
+        st, zbody, zh = get(f"/docs/d/ops?since={since}&limit=16")
+        assert st == 200
+        assert zbody == bbody, f"mismatch at since={since}"
+        assert zh["etag"] == bmeta["etag"], since
+        assert zh["x-since-more"] == ("1" if bmeta["more"] else "0")
+        nxt = zh.get("x-since-next")
+        assert (nxt is None) == (bmeta["next_since"] is None)
+        if nxt is not None:
+            assert int(nxt) == bmeta["next_since"]
+        if not bmeta["more"]:
+            break
+        since = bmeta["next_since"]
+    else:
+        pytest.fail("window chain never terminated")
+
+
+def test_conditional_get_304_on_zero_copy_path(served):
+    eng, get = served
+    _await_sendfile(eng, get, "/docs/d/ops?since=0&limit=16")
+    st, _body, h = get("/docs/d/ops?since=0&limit=16")
+    assert st == 200
+    st304, body304, h304 = get("/docs/d/ops?since=0&limit=16",
+                               {"If-None-Match": h["etag"]})
+    assert st304 == 304 and body304 == b""
+    assert h304["etag"] == h["etag"]
+
+
+def test_sendfile_off_baseline_identical(served, monkeypatch):
+    """GRAFT_SENDFILE=0 is the A/B baseline: no stats object, no
+    sidecars consulted, byte-identical windows."""
+    eng, get = served
+    (b0, _h0), _ = _await_sendfile(
+        eng, get, "/docs/d/ops?since=0&limit=16")
+    monkeypatch.setenv("GRAFT_SENDFILE", "0")
+    eng2 = ServingEngine(oplog_hot_ops=8)
+    assert eng2.sendfile_stats is None
+    _fill(eng2)
+    doc2 = eng2.get("d")
+    assert doc2.ops_window_plan(0, 16) is None
+    b2, _m2 = doc2.ops_since_window(0, 16)
+    assert b2 == b0, "baseline engine bytes differ"
+    eng2.close()
+
+
+def test_sidecars_removed_on_ephemeral_close():
+    """Ephemeral engines scrub their scratch segments on close — the
+    wire sidecars must go with them, never orphaned on disk."""
+    eng = ServingEngine(oplog_hot_ops=8)
+    _fill(eng, rounds=12)
+    log = eng.get("d").tree._log
+    segs = list(log._bases) + list(log._cold)
+    assert segs, "workload sealed no cold segments"
+    built = [s for s in segs if oplog_mod.ensure_wire_sidecar(s)]
+    assert built, "no sidecar built"
+    paths = [p for s in built for p in oplog_mod.wire_paths(s.path)]
+    for p in paths:
+        assert os.path.exists(p), p
+    eng.close()
+    for s in segs:
+        assert not os.path.exists(s.path), "segment survived close"
+    for p in paths:
+        assert not os.path.exists(p), f"orphaned sidecar: {p}"
+
+
+def test_prom_sendfile_families_strict_parse(monkeypatch):
+    """crdt_sendfile_* renders under the strict parser when armed
+    (default) and is ABSENT under GRAFT_SENDFILE=0."""
+    eng = ServingEngine(oplog_hot_ops=8)
+    _fill(eng, rounds=12)
+    fams = prom_mod.parse_text(eng.render_prom())
+    for fam in ("crdt_sendfile_windows_total",
+                "crdt_sendfile_bytes_total",
+                "crdt_sendfile_fallback_total",
+                "crdt_sendfile_sidecar_builds_total",
+                "crdt_sendfile_sidecar_build_failures_total"):
+        assert fam in fams, fam
+        assert fams[fam]["type"] == "counter"
+    eng.close()
+    monkeypatch.setenv("GRAFT_SENDFILE", "0")
+    off = ServingEngine(oplog_hot_ops=8)
+    fams2 = prom_mod.parse_text(off.render_prom())
+    assert not any(f.startswith("crdt_sendfile_") for f in fams2)
+    off.close()

@@ -29,6 +29,19 @@ def test_fleet_smoke_end_to_end():
     assert summary["fleet0"]["visible"] == 15      # 3 servers x 5 adds
 
 
+def test_fleet_procs_shm_exact_ledger():
+    """--fleet-procs mode (ISSUE 17): 3 REAL processes converge on one
+    document over 4 generations of the host-shared body cache — per
+    generation exactly one encode on the whole host (misses +1) and an
+    attach from everyone else (hits +(N-1)), zero degraded attaches,
+    zero leaked segments.  The assertions live in run_fleet_procs; the
+    summary re-pins the ledger at the tier-1 surface."""
+    summary = _serve_smoke.run_fleet_procs(n_procs=3, gens=4)
+    assert summary["misses"] == 4
+    assert summary["hits"] == 8
+    assert summary["shared_bytes"] > 0
+
+
 def test_serve_smoke_end_to_end():
     summary = _serve_smoke.run(n_docs=4, writers_per_doc=3, deltas=3,
                                delta_size=8)

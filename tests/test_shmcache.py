@@ -1,0 +1,222 @@
+"""Host-shared encoded-body cache (ISSUE 17; docs/SERVING.md
+§Shared-memory body cache): N processes serve ONE copy of each
+generation's encoded bodies.  Pins the sharing + accounting contract,
+the segment-lifetime rules (a held memoryview survives the publish
+swap, every release, and the unlink — no SIGBUS), the
+``GRAFT_READCACHE=0`` dual-tier bypass, cross-process attach, and the
+prom family gating.
+"""
+import json
+import os
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.serve import ServingEngine
+
+OFF = 2**32
+
+
+def chain_ops(r, n, start=1):
+    out = []
+    prev = r * OFF + start - 1 if start > 1 else 0
+    for c in range(start, start + n):
+        t = r * OFF + c
+        out.append(Add(t, (prev,), f"v{r}.{c}"))
+        prev = t
+    return out
+
+
+def _submit(eng, doc, ops):
+    return eng.submit(doc, json_codec.dumps(Batch(tuple(ops))))
+
+
+@pytest.fixture()
+def shm_ns(monkeypatch):
+    """A unique per-test shm namespace, so parallel test runs (and
+    leftovers from killed ones) can never collide."""
+    ns = f"t{uuid.uuid4().hex[:10]}"
+    monkeypatch.setenv("GRAFT_SHMCACHE_NS", ns)
+    return ns
+
+
+def _engine(**kw):
+    kw.setdefault("oplog_hot_ops", 8)
+    kw.setdefault("shmcache", True)
+    return ServingEngine(**kw)
+
+
+def _shm_listing(ns):
+    try:
+        return sorted(f for f in os.listdir("/dev/shm")
+                      if ns in f and not f.endswith(".manifest"))
+    except OSError:
+        return []
+
+
+def test_two_engines_share_one_segment(shm_ns):
+    """Converged engines (same doc state → same fingerprint) agree on
+    the segment name without coordination: the first encode publishes
+    (miss), the second ATTACHES (hit) and serves the same bytes."""
+    e1, e2 = _engine(), _engine()
+    assert e1.shmcache is not None and e2.shmcache is not None
+    ops = chain_ops(1, 12)
+    for eng in (e1, e2):
+        ok, _ = _submit(eng, "d", ops)
+        assert ok
+    s1, s2 = e1.get("d").read_view(), e2.get("d").read_view()
+    assert s1.state_fingerprint() == s2.state_fingerprint()
+    b1 = bytes(s1.values_body())
+    b2 = bytes(s2.values_body())
+    assert b1 == b2
+    assert bytes(s1.clock_body()) == bytes(s2.clock_body())
+    st1 = e1.shmcache.stats.snapshot()
+    st2 = e2.shmcache.stats.snapshot()
+    assert st1["misses"] == 1 and st1["hits"] == 0, st1
+    assert st2["hits"] == 1 and st2["misses"] == 0, st2
+    assert s1.shm_seg_name == s2.shm_seg_name is not None
+    e1.close()
+    e2.close()
+    assert _shm_listing(shm_ns) == [], "segments leaked past close"
+
+
+def test_held_memoryview_survives_swap_release_and_unlink(shm_ns):
+    """The parked-watcher / mid-write-reader lifetime contract: a
+    memoryview taken from a shared segment stays valid across the
+    publish swap that releases the generation's claim, across engine
+    close, and across the unlink itself (POSIX keeps the mapping until
+    the last map drops) — reading it can never SIGBUS."""
+    eng = _engine()
+    ok, _ = _submit(eng, "d", chain_ops(1, 8))
+    assert ok
+    snap = eng.get("d").read_view()
+    mv = snap.values_body()
+    assert isinstance(mv, memoryview)
+    want = bytes(mv)
+    seg = snap.shm_seg_name
+    assert seg is not None
+    # publish swap: the outgoing generation's claim is released
+    ok, _ = _submit(eng, "d", chain_ops(1, 8, start=9))
+    assert ok
+    assert eng.flush(20)
+    fresh = eng.get("d").read_view()
+    assert fresh.seq > snap.seq
+    assert bytes(mv) == want
+    eng.close()
+    # all claims dropped, name unlinked — the held view still reads
+    assert _shm_listing(shm_ns) == []
+    assert bytes(mv) == want
+    assert json.loads(want.decode())["values"] == list(snap.values)
+
+
+def test_readcache_off_bypasses_both_tiers(shm_ns, monkeypatch):
+    """GRAFT_READCACHE=0 restores the per-request re-encode path: no
+    shared tier is even constructed, and the wire bytes stay
+    byte-identical to the dual-tier engine's."""
+    cached = _engine()
+    ok, _ = _submit(cached, "d", chain_ops(1, 10))
+    assert ok
+    want_vals = bytes(cached.get("d").read_view().values_body())
+    want_clock = bytes(cached.get("d").read_view().clock_body())
+
+    monkeypatch.setenv("GRAFT_READCACHE", "0")
+    plain = _engine()
+    assert plain.shmcache is None
+    ok, _ = _submit(plain, "d", chain_ops(1, 10))
+    assert ok
+    snap = plain.get("d").read_view()
+    assert bytes(snap.values_body()) == want_vals
+    assert bytes(snap.clock_body()) == want_clock
+    assert snap.shm_seg_name is None
+    cached.close()
+    plain.close()
+
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.serve import ServingEngine
+
+OFF = 2**32
+ops, prev = [], 0
+for c in range(1, 13):
+    t = OFF + c
+    ops.append(Add(t, (prev,), f"v1.{c}"))
+    prev = t
+eng = ServingEngine(oplog_hot_ops=8, shmcache=True)
+assert eng.shmcache is not None
+ok, _ = eng.submit("d", json_codec.dumps(Batch(tuple(ops))))
+assert ok
+snap = eng.get("d").read_view()
+body = bytes(snap.values_body())
+out = {"stats": eng.shmcache.stats.snapshot(),
+       "seg": snap.shm_seg_name,
+       "body_sha": __import__("hashlib").sha1(body).hexdigest()}
+eng.close()
+print(json.dumps(out))
+"""
+
+
+def test_cross_process_attach_single_encode(shm_ns):
+    """A REAL second process converging on the same doc attaches the
+    parent's segment: child stats show hits=1/misses=0 and the same
+    bytes — the fleet's one-encode-per-host property."""
+    import hashlib
+    eng = _engine()
+    ok, _ = _submit(eng, "d", chain_ops(1, 12))
+    assert ok
+    snap = eng.get("d").read_view()
+    body = bytes(snap.values_body())
+    assert snap.shm_seg_name is not None
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["seg"] == snap.shm_seg_name
+    assert got["stats"]["hits"] == 1 and got["stats"]["misses"] == 0
+    assert got["body_sha"] == hashlib.sha1(body).hexdigest()
+    eng.close()
+    assert _shm_listing(shm_ns) == []
+
+
+def test_prom_shmcache_families_strict_parse(shm_ns):
+    """crdt_shmcache_* renders under the strict parser when armed and
+    is ABSENT on a default (shmcache-off) engine — same presence
+    gating as crdt_wal_*."""
+    eng = _engine()
+    ok, _ = _submit(eng, "d", chain_ops(1, 8))
+    assert ok
+    bytes(eng.get("d").read_view().values_body())
+    fams = prom_mod.parse_text(eng.render_prom())
+    for fam in ("crdt_shmcache_hits_total", "crdt_shmcache_misses_total",
+                "crdt_shmcache_attach_failed_total",
+                "crdt_shmcache_shared_bytes_total",
+                "crdt_shmcache_released_total",
+                "crdt_shmcache_scavenged_total"):
+        assert fam in fams, fam
+        assert fams[fam]["type"] == "counter"
+    sample = fams["crdt_shmcache_misses_total"]["samples"][0]
+    assert sample[2] >= 1.0
+    eng.close()
+    off = ServingEngine(oplog_hot_ops=8)
+    fams2 = prom_mod.parse_text(off.render_prom())
+    assert not any(f.startswith("crdt_shmcache_") for f in fams2)
+    off.close()

@@ -306,16 +306,21 @@ def test_disk_full_sheds_503_and_server_stays_up(tmp_path):
     doc = eng.get("ddoc")
     vals_before = doc.snapshot()
     real_sync = doc.wal.sync
+    real_sync_begin = doc.wal.sync_begin
 
-    def enospc():
+    def enospc(*_a, **_k):
         raise OSError(28, "No space left on device")
 
+    # fail both WAL durability seams: sync() for the single/threaded
+    # lanes, sync_begin() for completion-driven backends
     doc.wal.sync = enospc
+    doc.wal.sync_begin = enospc
     try:
         with pytest.raises(WalUnavailable):
             _submit(eng, "ddoc", chain_ops(1, 5, start=6))
     finally:
         doc.wal.sync = real_sync
+        doc.wal.sync_begin = real_sync_begin
     # server alive: reads serve the last PUBLISHED snapshot, the
     # scheduler thread survived, the shed is counted, and the merge
     # was ROLLED BACK (the log must never hold ops in neither the
