@@ -37,8 +37,12 @@ tests/test_oracle.py and scripts/bench_serve_headline.py).
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import json
 import random
+import re
+import selectors
+import socket
 import threading
 import time
 from http.client import HTTPConnection, HTTPException
@@ -107,6 +111,13 @@ class LoadgenConfig:
     #                                limit) → one encode per generation
     watch_timeout_s: float = 2.0   # per-request park budget (also
     #                                bounds harness teardown)
+    # watcher transport (ISSUE 18): a thread per watcher caps the
+    # CLIENT at ~1k sessions — the selector driver runs the whole
+    # population as raw keep-alive sockets on ONE thread, which is
+    # what lets the harness actually offer the 10k+ populations the
+    # reactor parks.  None = auto (selector from 64 watchers up);
+    # delivery semantics, counters, and oracle checks are identical.
+    watch_selector: Optional[bool] = None
 
 
 class _Session(threading.Thread):
@@ -336,6 +347,277 @@ class _Watcher(threading.Thread):
                     resp.getheader(SNAP_FP_HEADER))
 
 
+class _WatchSession:
+    """Per-watcher state for the selector driver — the same public
+    counters as :class:`_Watcher` so report aggregation is transport-
+    blind."""
+
+    __slots__ = ("idx", "sid", "doc", "since", "etag", "sock", "buf",
+                 "out", "inflight", "connected", "resp_deadline",
+                 "done", "deliveries", "notifies", "heartbeats",
+                 "sheds", "rejected_429", "bytes_rx", "errors")
+
+    def __init__(self, idx: int, n_docs: int):
+        self.idx = idx
+        self.sid = f"watch-{idx:04d}"
+        self.doc = f"load{idx % n_docs}"
+        self.since = 0
+        self.etag: Optional[str] = None
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""                 # accumulated response bytes
+        self.out = b""                 # unsent request bytes
+        self.inflight = False
+        self.connected = False         # first request fully written
+        self.resp_deadline = 0.0
+        self.done = False
+        self.deliveries = 0
+        self.notifies = 0
+        self.heartbeats = 0
+        self.sheds = 0
+        self.rejected_429 = 0
+        self.bytes_rx = 0
+        self.errors: List[str] = []
+
+
+class _SelectorWatchers(threading.Thread):
+    """The watcher population as ONE thread over raw keep-alive
+    sockets (ISSUE 18): nonblocking connects in bounded waves (the
+    server's accept backlog is finite), a per-session request/response
+    state machine, and a retry heap for the 429/404 backoffs.  Each
+    completed response runs the SAME delivery logic as the thread
+    client — event taxonomy, ``If-None-Match`` ETag carry, resume-mark
+    advance, oracle ``observe_read`` — so the push-read session
+    guarantees are checked identically at any population size."""
+
+    CONNECT_WAVE = 128                 # outstanding connects at once
+
+    def __init__(self, harness: "_Harness", n: int,
+                 stop: threading.Event):
+        super().__init__(name="loadgen-watch-selector", daemon=True)
+        self.h = harness
+        self.stop = stop
+        cfg = harness.cfg
+        self.sessions = [_WatchSession(i, cfg.n_docs)
+                         for i in range(n)]
+        self.sel = selectors.DefaultSelector()
+        self._delays: List = []        # heap of (wake_at, idx)
+        self._pending = list(range(n))  # not yet connected
+        self._live = 0
+        self._connecting = 0           # handshakes in progress
+
+    # -- request plumbing --------------------------------------------------
+
+    def _request_bytes(self, ws: _WatchSession) -> bytes:
+        cfg = self.h.cfg
+        etag = (f"If-None-Match: {ws.etag}\r\n"
+                if ws.etag is not None else "")
+        return (f"GET /docs/{ws.doc}/watch?since={ws.since}"
+                f"&limit={cfg.watch_limit}"
+                f"&timeout={cfg.watch_timeout_s} HTTP/1.1\r\n"
+                f"Host: loadgen\r\n"
+                f"{SESSION_HEADER}: {ws.sid}\r\n{etag}\r\n").encode()
+
+    def _connect(self, ws: _WatchSession) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        try:
+            s.connect(("127.0.0.1", self.h.port))
+        except BlockingIOError:
+            pass
+        except OSError as e:
+            ws.errors.append(repr(e))
+            ws.done = True
+            s.close()
+            return
+        ws.sock = s
+        ws.out = self._request_bytes(ws)
+        ws.inflight = True
+        ws.resp_deadline = time.monotonic() + \
+            self.h.cfg.watch_timeout_s + 60
+        self._live += 1
+        self._connecting += 1
+        self.sel.register(s, selectors.EVENT_WRITE, ws)
+
+    def _send_next(self, ws: _WatchSession, delay: float = 0.0) -> None:
+        if self.stop.is_set():
+            self._close(ws)
+            return
+        if delay > 0.0:
+            heapq.heappush(self._delays,
+                           (time.monotonic() + delay, ws.idx))
+            return
+        ws.out = self._request_bytes(ws)
+        ws.inflight = True
+        ws.resp_deadline = time.monotonic() + \
+            self.h.cfg.watch_timeout_s + 60
+        self.sel.modify(ws.sock, selectors.EVENT_WRITE, ws)
+
+    def _close(self, ws: _WatchSession, err: Optional[str] = None) -> None:
+        if err is not None and not self.stop.is_set():
+            ws.errors.append(err)
+        if ws.sock is not None:
+            try:
+                self.sel.unregister(ws.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                ws.sock.close()
+            except OSError:
+                pass
+            ws.sock = None
+            self._live -= 1
+            if not ws.connected:
+                self._connecting -= 1
+        ws.done = True
+
+    # -- response handling -------------------------------------------------
+
+    def _on_writable(self, ws: _WatchSession) -> None:
+        err = ws.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+        if err:
+            self._close(ws, f"connect errno {err}")
+            return
+        try:
+            n = ws.sock.send(ws.out)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as e:
+            self._close(ws, repr(e))
+            return
+        ws.out = ws.out[n:]
+        if not ws.out:
+            if not ws.connected:
+                ws.connected = True
+                self._connecting -= 1
+            self.sel.modify(ws.sock, selectors.EVENT_READ, ws)
+
+    def _on_readable(self, ws: _WatchSession) -> None:
+        try:
+            chunk = ws.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as e:
+            self._close(ws, repr(e))
+            return
+        if not chunk:
+            self._close(ws, None if self.stop.is_set()
+                        else "server closed connection")
+            return
+        ws.buf += chunk
+        while ws.inflight:
+            end = ws.buf.find(b"\r\n\r\n")
+            if end < 0:
+                return
+            head = ws.buf[:end]
+            m = re.search(rb"Content-Length: (\d+)", head)
+            clen = int(m.group(1)) if m else 0
+            if len(ws.buf) < end + 4 + clen:
+                return
+            body = ws.buf[end + 4:end + 4 + clen]
+            ws.buf = ws.buf[end + 4 + clen:]
+            ws.inflight = False
+            self._process(ws, head, body)
+
+    def _process(self, ws: _WatchSession, head: bytes,
+                 body: bytes) -> None:
+        """One response, same branch structure as ``_Watcher.run``."""
+        status = int(head.split(None, 2)[1])
+        hdrs = {}
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b": ")
+            hdrs[k.decode("latin-1").lower()] = v.decode("latin-1")
+        if hdrs.get("connection", "").lower() == "close":
+            self._close(ws, f"connection closed on {status}")
+            return
+        if status == 429:
+            ws.rejected_429 += 1
+            self._send_next(ws, delay=min(
+                float(hdrs.get("retry-after") or 1), 0.05))
+            return
+        if status == 404:
+            self._send_next(ws, delay=0.01)
+            return
+        if status != 200:
+            self._close(ws, f"watch -> {status}")
+            return
+        event = hdrs.get(WATCH_EVENT_HEADER.lower())
+        ws.etag = hdrs.get("etag", ws.etag)
+        nxt = hdrs.get(SINCE_NEXT_HEADER.lower())
+        if nxt is not None:
+            ws.since = int(nxt)
+        if event == "timeout":
+            ws.heartbeats += 1
+            self._send_next(ws)
+            return
+        if event == "shed":
+            ws.sheds += 1
+        elif event == "notify":
+            ws.notifies += 1
+        ws.deliveries += 1
+        ws.bytes_rx += len(body)
+        seq = hdrs.get(COMMIT_SEQ_HEADER.lower())
+        if seq is not None:
+            self.h.oracle.observe_read(
+                ws.sid, ws.doc, int(seq),
+                hdrs.get(SNAP_FP_HEADER.lower()))
+        self._send_next(ws)
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            self._run()
+        finally:
+            for ws in self.sessions:
+                if not ws.done:
+                    self._close(ws)
+            self.sel.close()
+
+    def _run(self) -> None:
+        drain_by: Optional[float] = None
+        while True:
+            # connect wave: keep the in-progress herd bounded so the
+            # listener's backlog (128) never RSTs a wave
+            while self._pending and not self.stop.is_set() \
+                    and self._connecting < self.CONNECT_WAVE:
+                self._connect(self.sessions[self._pending.pop(0)])
+            now = time.monotonic()
+            while self._delays and self._delays[0][0] <= now:
+                _, idx = heapq.heappop(self._delays)
+                ws = self.sessions[idx]
+                if not ws.done:
+                    self._send_next(ws)
+            timeout = 0.2
+            if self._delays:
+                timeout = max(0.0, min(
+                    timeout, self._delays[0][0] - now))
+            for key, mask in self.sel.select(timeout):
+                ws = key.data
+                if ws.done:
+                    continue
+                if mask & selectors.EVENT_WRITE:
+                    self._on_writable(ws)
+                if mask & selectors.EVENT_READ and not ws.done:
+                    self._on_readable(ws)
+            now = time.monotonic()
+            for ws in self.sessions:
+                if not ws.done and ws.inflight \
+                        and now > ws.resp_deadline:
+                    self._close(ws, "response deadline")
+            if self.stop.is_set():
+                # teardown parity with the thread client: in-flight
+                # parks drain at their budget (the server heartbeats
+                # them out), idle sockets close now
+                if drain_by is None:
+                    drain_by = now + self.h.cfg.watch_timeout_s + 30
+                for ws in self.sessions:
+                    if not ws.done and not ws.inflight:
+                        self._close(ws)
+                if all(ws.done for ws in self.sessions) \
+                        or now > drain_by:
+                    return
+
+
 class _Harness:
     def __init__(self, cfg: LoadgenConfig, engine: ServingEngine,
                  port: int, oracle: oracle_mod.SessionOracle):
@@ -398,10 +680,20 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     # watchers start FIRST so the earliest generations are delivered
     # as notifies (parked wakes), not just resumes of history
     watch_stop = threading.Event()
-    watchers = [_Watcher(harness, i, watch_stop)
-                for i in range(cfg.n_watchers)]
-    for wt in watchers:
-        wt.start()
+    use_selector = (cfg.watch_selector if cfg.watch_selector
+                    is not None else cfg.n_watchers >= 64)
+    if cfg.n_watchers and use_selector:
+        watch_driver = _SelectorWatchers(harness, cfg.n_watchers,
+                                         watch_stop)
+        watch_driver.start()
+        watchers: List[Any] = watch_driver.sessions
+        watch_joiners: List[threading.Thread] = [watch_driver]
+    else:
+        watchers = [_Watcher(harness, i, watch_stop)
+                    for i in range(cfg.n_watchers)]
+        watch_joiners = watchers
+        for wt in watchers:
+            wt.start()
 
     staged = False
     if cfg.stage_first_round and cfg.n_sessions >= 2:
@@ -482,7 +774,7 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
     load_wall_s = time.perf_counter() - t_start
     # release the watchers: an in-flight park drains at its budget
     watch_stop.set()
-    for wt in watchers:
+    for wt in watch_joiners:
         wt.join(cfg.watch_timeout_s + 120)
 
     # quiescence: drain everything admitted above and flush the flight
@@ -620,6 +912,7 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         # notify-latency percentiles
         "watch": ({
             "watchers": cfg.n_watchers,
+            "client": "selector" if use_selector else "threads",
             "deliveries": sum(wt.deliveries for wt in watchers),
             "notifies": sum(wt.notifies for wt in watchers),
             "heartbeats": sum(wt.heartbeats for wt in watchers),
@@ -880,6 +1173,7 @@ class _FleetSession(threading.Thread):
         self.leaves_acked = 0
         self.shed_429 = 0
         self.retry_409 = 0
+        self.read_refused_503 = 0
         self.errors: List[str] = []
 
     def _entry_server(self):
@@ -999,6 +1293,16 @@ class _FleetSession(threading.Thread):
         ms = (time.perf_counter() - t0) * 1e3
         if resp.status == 404:
             return False                  # not yet synced to this node
+        if resp.status == 503 and resp.getheader("Retry-After"):
+            # the server's honest refusals, not session errors: a
+            # rejoining replica still catching the doc up (PR 8 turned
+            # the old not-yet-synced 404 into 503 + Retry-After +
+            # X-Catchup-Remaining) or the bounded-staleness gate
+            # declining to serve a too-stale local generation — both
+            # mean "ask another replica / come back", exactly like the
+            # 404 branch above
+            self.read_refused_503 += 1
+            return False
         if resp.status != 200:
             self.errors.append(f"read -> {resp.status}")
             return False
@@ -1361,6 +1665,7 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         "ops_per_sec": round(leaves / load_wall_s, 1),
         "shed_429": sum(s.shed_429 for s in sessions),
         "retry_409": sum(s.retry_409 for s in sessions),
+        "read_refused_503": sum(s.read_refused_503 for s in sessions),
         "reads_primary": len(rp),
         "reads_replica": len(rr),
         "read_primary_p50_ms": _pct(rp, 50),

@@ -630,6 +630,15 @@ class ClusterNode:
     def flight(self):
         return self.engine.flight
 
+    @property
+    def reactor(self):
+        # reactor egress (serve/reactor.py): the watch detach seam
+        # resolves the reactor through the store, so a fleet node's
+        # watchers park selector-side exactly like a single engine's —
+        # with the fleet lag stamps re-sampled at delivery through
+        # extra_read_headers
+        return self.engine.reactor
+
     def cluster_stats(self) -> Dict:
         with self._counter_lock:
             counters = dict(self.counters)

@@ -327,6 +327,72 @@ def render_engine(engine) -> str:
                         h["bounds"], h["counts"], h["count"], h["sum"],
                         {"doc": d.doc_id})
 
+    # -- reactor egress tier (serve/reactor.py; ISSUE 18) -----------------
+    # the selector-loop delivery tier: parked-connection occupancy, loop
+    # activity, partial-write continuations, egress-buffer accounting and
+    # the shed/reap/re-injection counters.  Families are ABSENT when the
+    # engine runs the threaded park path (GRAFT_REACTOR=0) so a strict
+    # parse of the text format doubles as the A/B presence gate.
+    reactor = getattr(engine, "reactor", None)
+    if reactor is not None:
+        snap = reactor.snapshot()
+        for name, help_text, key in (
+                ("crdt_reactor_parked",
+                 "Watch connections parked on reactor selector loops",
+                 "parked"),
+                ("crdt_reactor_parked_peak",
+                 "High-water mark of reactor-parked connections",
+                 "parked_peak"),
+                ("crdt_reactor_threads",
+                 "Reactor loop threads running "
+                 "(GRAFT_REACTOR_THREADS, capped at 4)", "threads"),
+                ("crdt_reactor_started",
+                 "1 once the first park lazily spawned the loops",
+                 "started"),
+                ("crdt_reactor_egress_buffer_bytes",
+                 "Bytes queued in per-connection egress buffers",
+                 "egress_buffer_bytes"),
+                ("crdt_reactor_egress_buffer_high_water_bytes",
+                 "Largest single-connection egress backlog observed",
+                 "buf_hw"),
+                ("crdt_reactor_timer_depth",
+                 "Connections filed on the heartbeat/deadline timing "
+                 "wheel", "timer_depth")):
+            w.gauge(name, help_text, snap[key])
+        for name, help_text, key in (
+                ("crdt_reactor_detached_total",
+                 "Watch connections handed off from a handler thread "
+                 "to the reactor", "detached"),
+                ("crdt_reactor_loops_total",
+                 "Selector loop iterations across reactor threads",
+                 "loops"),
+                ("crdt_reactor_wakeups_total",
+                 "Cross-thread wake-pipe signals drained", "wakeups"),
+                ("crdt_reactor_notified_total",
+                 "Publish deliveries written from a reactor loop",
+                 "notified"),
+                ("crdt_reactor_partial_writes_total",
+                 "Non-blocking writes that hit EAGAIN or a short "
+                 "send and re-armed EPOLLOUT", "partial_writes"),
+                ("crdt_reactor_timers_fired_total",
+                 "Timing-wheel expirations (heartbeats + park "
+                 "deadlines)", "timers_fired"),
+                ("crdt_reactor_reaps_total",
+                 "Parked connections reaped on EOF/socket error",
+                 "reaps"),
+                ("crdt_reactor_reinjects_total",
+                 "Keep-alive sockets re-injected into handler "
+                 "threads for a pipelined request", "reinjects"),
+                ("crdt_reactor_closes_total",
+                 "Named closes written during registry shutdown",
+                 "closes")):
+            w.family(name, "counter", help_text)
+            w.sample(name, name, snap[key], {})
+        w.family("crdt_reactor_sheds_total", "counter",
+                 "Reactor-side slow-consumer sheds by reason")
+        w.sample("crdt_reactor_sheds_total", "crdt_reactor_sheds_total",
+                 snap["sheds_buffer"], {"reason": "buffer"})
+
     # -- scrub & repair (docs/DURABILITY.md §Scrub & repair) --------------
     # rendered per tiered doc: the bit-rot sweep's verified/corrupt/
     # repaired counters plus the live quarantined-segment gauge

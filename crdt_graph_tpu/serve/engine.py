@@ -163,6 +163,10 @@ class ServedDoc:
             doc_id, max_watchers=engine.watch_max,
             park_s=engine.watch_park_s,
             heartbeat_s=engine.watch_heartbeat_s)
+        # reactor-backed park mode (serve/reactor.py; ISSUE 18): when
+        # the engine runs a reactor, notify/close fan out to detached
+        # selector-parked connections too
+        self.watch.reactor = engine.reactor
         # scrub-with-peer-repair (docs/DURABILITY.md §Scrub & repair):
         # the maintenance lane's cadence sweep re-verifies cold-file
         # checksums and heals quarantined ranges from fleet peers
@@ -652,6 +656,7 @@ class ServingEngine:
                  readcache_windows: Optional[int] = None,
                  shmcache: Optional[bool] = None,
                  watch_max: Optional[int] = None,
+                 reactor: Optional[bool] = None,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
                  wal_shared: Optional[bool] = None,
@@ -716,6 +721,27 @@ class ServingEngine:
                                        watch_mod.DEFAULT_PARK_S)
         self.watch_heartbeat_s = _env_float(
             "GRAFT_WATCH_HEARTBEAT_S", watch_mod.DEFAULT_HEARTBEAT_S)
+        # reactor egress (serve/reactor.py; ISSUE 18): on by default —
+        # parked watch connections detach from their handler threads
+        # onto GRAFT_REACTOR_THREADS selector loops (lazy-started at
+        # the first park; hard-capped at 4).  GRAFT_REACTOR=0 restores
+        # the thread-per-parked-watcher path — the byte-identical A/B
+        # baseline.  Construction failure (no selector/pipe) degrades
+        # to threaded parking rather than refusing to serve.
+        if reactor is None:
+            reactor = os.environ.get(
+                "GRAFT_REACTOR", "1").strip() not in ("", "0")
+        self.reactor = None
+        if reactor:
+            from . import reactor as reactor_mod
+            try:
+                self.reactor = reactor_mod.Reactor(
+                    threads=_env_int("GRAFT_REACTOR_THREADS",
+                                     reactor_mod.DEFAULT_THREADS),
+                    buf_cap=_env_int("GRAFT_REACTOR_BUF",
+                                     reactor_mod.DEFAULT_BUF_CAP))
+            except (OSError, ValueError):
+                self.reactor = None
         # crash durability (wal.py; docs/DURABILITY.md): a durable_dir
         # puts every document's tiers + WAL in a persistent per-doc
         # subdir; acked writes then survive a kill (fsync-before-ack,
@@ -1146,6 +1172,11 @@ class ServingEngine:
         # clean shutdown would stall up to a full park budget
         for d in self.docs():
             d.watch.close()
+        if self.reactor is not None:
+            # the registries' close commands are already queued on the
+            # loops: draining writes every reactor-parked watcher its
+            # named close (503 / event: closed) before the loops join
+            self.reactor.stop(timeout=timeout)
         self.scheduler.shutdown(timeout=timeout)
         if self.sync_worker is not None:
             self.sync_worker.stop(timeout=timeout)

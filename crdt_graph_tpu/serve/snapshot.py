@@ -396,6 +396,21 @@ class DocSnapshot:
 
         return self._window_cached(("w", since, limit), compute)
 
+    def pinned_window(self, since: int, limit: int = 0):
+        """:meth:`ops_since_window` plus an explicit buffer-lifetime
+        pin: ``(body, meta, pin)`` where holding ``pin`` (this
+        snapshot) for the life of an in-flight write guarantees the
+        body bytes cannot be torn by a publish swap — the window LRU,
+        the encode, and (for shm-backed whole-doc bodies) the segment
+        claim all live on the snapshot, and the shmcache zombie-park
+        contract (serve/shmcache.py) keeps exported views mapped even
+        across a swap + unlink.  The reactor (serve/reactor.py) pins
+        every queued delivery until its last byte drains; partial
+        writes that straddle a generation swap complete from the
+        pinned buffer."""
+        body, meta = self.ops_since_window(since, limit)
+        return body, meta, self
+
     def ops_since_bytes(self, since: int) -> bytes:
         """Wire JSON for ``GET /ops?since=`` off the pinned view — the
         SAME egress bytes the live tree serves

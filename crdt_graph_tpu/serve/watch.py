@@ -69,6 +69,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..obs.trace import (COMMIT_SEQ_HEADER, SESSION_HEADER,
+                         SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
+                         SINCE_NEXT_HEADER, SNAP_FP_HEADER)
 from .metrics import Histogram, LATENCY_BOUNDS_MS
 
 # per-doc concurrent-watcher cap (GRAFT_WATCH_MAX): past it the watch
@@ -84,6 +87,47 @@ DEFAULT_HEARTBEAT_S = 10.0
 # ceiling on one request's park, and therefore on how long a dead
 # long-poll connection can pin a registry slot
 DEFAULT_PARK_S = 30.0
+
+
+def watch_fresh(meta: Dict[str, Any], since: int) -> bool:
+    """Whether a window carries something a client parked at ``since``
+    lacks.  ``count > 0`` alone cannot decide it: the chain contract
+    RE-SERVES the inclusive Add terminator, so a fully caught-up mark
+    still gets a non-empty window (``next_since == since``).  Fresh
+    means: unknown mark (reset), a trimmed window (shed), or adds
+    beyond the terminator (``next_since`` moved).
+
+    Shared by the threaded handler (service/http.py) and the reactor
+    (serve/reactor.py) so the two delivery paths cannot drift on the
+    one predicate that decides what goes on the wire."""
+    return (not meta["found"] or bool(meta["more"])
+            or (meta["count"] > 0 and meta["next_since"] != since))
+
+
+def delivery_headers(store, snap, meta: Dict[str, Any], since: int,
+                     session_id: str) -> Dict[str, str]:
+    """The ordered header dict of one watch window delivery: snapshot
+    identity, session echo, fleet replica/lag stamps (re-sampled at
+    delivery time — a park can outlive the admission-time sample),
+    the ``X-Since-*`` resume state, and the window ``ETag``.
+
+    ONE builder for both delivery tiers (threaded handler and
+    reactor): the ``GRAFT_REACTOR=0`` A/B byte-identity contract is
+    enforced by construction, not by parallel maintenance.
+    ``session_id`` must already be ensured (adopted or minted)."""
+    out = {
+        SNAP_FP_HEADER: snap.fingerprint(),
+        COMMIT_SEQ_HEADER: str(snap.seq),
+        SESSION_HEADER: session_id,
+    }
+    if hasattr(store, "extra_read_headers"):
+        out.update(store.extra_read_headers(snap, ae_lag_hdr=None))
+    out[SINCE_FOUND_HEADER] = "1" if meta["found"] else "0"
+    out[SINCE_MORE_HEADER] = "1" if meta["more"] else "0"
+    if meta["next_since"] is not None:
+        out[SINCE_NEXT_HEADER] = str(meta["next_since"])
+    out["ETag"] = meta["etag"]
+    return out
 
 
 class WatchFull(Exception):
@@ -168,9 +212,15 @@ class WatchRegistry:
         self._cond = threading.Condition()
         self._registered = 0    # admitted watcher slots currently held
         self._parked = 0        # currently inside a wait
+        self._reactor_parked = 0   # slots parked on the reactor instead
         self._seq = 0           # latest published generation
         self._published_at = 0.0   # perf_counter of that publish
         self._closed = False
+        # reactor-backed park mode (serve/reactor.py; ISSUE 18): when
+        # the engine runs a reactor, ServedDoc points the registry at
+        # it and parked long-poll/SSE connections detach from their
+        # handler threads — notify/close fan out to BOTH populations
+        self.reactor = None
 
     # -- publisher side (any committing thread) ---------------------------
 
@@ -185,6 +235,11 @@ class WatchRegistry:
                 self._seq = seq
                 self._published_at = now
             self._cond.notify_all()
+        r = self.reactor
+        if r is not None:
+            # outside the condition: the reactor enqueues a command +
+            # one wakeup-pipe byte per loop — O(loops), never O(watchers)
+            r.notify(self, seq, now)
 
     def close(self) -> None:
         """Engine shutdown / fleet crash: wake every parked watcher
@@ -193,6 +248,11 @@ class WatchRegistry:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        r = self.reactor
+        if r is not None:
+            # reactor-parked watchers get the same named close (503 /
+            # event: closed) written by the loop that owns their socket
+            r.close_registry(self)
 
     # -- watcher side (handler threads) -----------------------------------
 
@@ -211,6 +271,24 @@ class WatchRegistry:
     def unregister(self) -> None:
         with self._cond:
             self._registered -= 1
+
+    def note_reactor_park(self, n: int) -> None:
+        """Reactor bookkeeping: ``+1`` when a detached connection's
+        park begins on a reactor loop, ``-1`` when its delivery /
+        heartbeat / reap / close releases the slot.  Keeps
+        :meth:`counts` honest — tests and the prom gauge read one
+        number for 'watchers parked' regardless of which tier parks
+        them."""
+        with self._cond:
+            self._reactor_parked += n
+
+    def published_state(self):
+        """``(seq, published_at, closed)`` — the reactor re-checks
+        this when it picks a park command up, closing the missed-wake
+        window between the handler's freshness check and the loop's
+        selector registration."""
+        with self._cond:
+            return self._seq, self._published_at, self._closed
 
     def wait_beyond(self, seq: int, timeout: float):
         """Park until a generation PAST ``seq`` publishes.  Returns
@@ -236,7 +314,8 @@ class WatchRegistry:
     def counts(self) -> Dict[str, int]:
         with self._cond:
             return {"registered": self._registered,
-                    "parked": self._parked,
+                    "parked": self._parked + self._reactor_parked,
+                    "reactor_parked": self._reactor_parked,
                     "max": self.max_watchers}
 
     def snapshot(self) -> Dict[str, Any]:

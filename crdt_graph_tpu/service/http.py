@@ -112,6 +112,7 @@ from ..cluster.gateway import ForwardError
 from ..oplog import EMPTY_BATCH_BYTES
 from ..serve import (ECHO_LIMIT, QueueFull, SchedulerError,
                      SchedulerStopped, ServingEngine)
+from ..serve import watch as watch_mod
 from ..serve.watch import WatchClosed, WatchFull
 from .store import DocumentStore
 
@@ -318,16 +319,54 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
 
         @staticmethod
         def _watch_fresh(meta, since) -> bool:
-            """Whether the window carries something a client parked at
-            ``since`` lacks.  ``count > 0`` alone cannot decide it:
-            the chain contract RE-SERVES the inclusive Add terminator,
-            so a fully caught-up mark still gets a non-empty window
-            (``next_since == since``).  Fresh means: unknown mark
-            (reset), a trimmed window (shed), or adds beyond the
-            terminator (``next_since`` moved)."""
-            return (not meta["found"] or bool(meta["more"])
-                    or (meta["count"] > 0
-                        and meta["next_since"] != since))
+            """Shared freshness predicate (serve/watch.py) — ONE
+            implementation for the threaded park path and the reactor,
+            so the wire cannot drift between them."""
+            return watch_mod.watch_fresh(meta, since)
+
+        def _watch_detach(self, reactor, doc, reg, mode, since, limit,
+                          deadline, parked_seq,
+                          hb_deadline=None) -> bool:
+            """The detach seam (ISSUE 18; serve/reactor.py): this
+            caught-up watch connection's socket leaves the handler
+            thread and parks on the reactor.  Everything
+            request-shaped already happened here — parsing, admission,
+            the staleness gate, the resume walk; the reactor only ever
+            delivers forward from ``since``.  Steps: drain the
+            buffered writer (header/stream bytes must precede reactor
+            bytes), mark the socket detached so the server-side
+            teardown skips its shutdown/close, flag the registry slot
+            as reactor-owned (the ``finally`` below must not release
+            it), and hand the socket over.  Returns False when
+            detaching is not possible (no reactor-capable server, or
+            the reactor is stopped) — the caller falls back to the
+            threaded park, same wire either way."""
+            if not hasattr(self.server, "note_detached"):
+                return False
+            if not reactor.ensure_started():
+                return False
+            self.wfile.flush()
+            sess = ensure_session_id(self.headers.get(SESSION_HEADER))
+            keep_alive = not self.close_connection
+            self.server.note_detached(self.connection)
+            self._watch_detached = True
+            # exit the keep-alive handler loop WITHOUT closing: the
+            # skip in shutdown_request keeps the fd alive; rfile/wfile
+            # close in finish() but the socket object stays open
+            self.close_connection = True
+            if reactor.park(self.connection, self.client_address,
+                            store, doc, reg, mode, since, limit,
+                            deadline, parked_seq, sess, keep_alive,
+                            hb_deadline=hb_deadline):
+                return True
+            # stopped between ensure_started and park (shutdown race):
+            # the socket is already detached — close it here
+            self._watch_detached = False
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return True
 
         def _watch_poll(self, doc, reg, since, limit, timeout):
             """One long-poll watch round trip (serve/watch.py): answer
@@ -355,6 +394,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             parked, woke_at = False, 0.0
             last_seq = None
             inm = self.headers.get("If-None-Match")
+            reactor = getattr(store, "reactor", None)
             while True:
                 snap = doc.snapshot_view()
                 body, meta = snap.ops_since_window(since, limit)
@@ -369,9 +409,12 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                         and not etag_matches(inm, meta["etag"]):
                     fresh = True
                 if fresh:
-                    hdrs = self._read_trace_headers(snap)
-                    self._since_headers(hdrs, meta)
-                    hdrs["ETag"] = meta["etag"]
+                    # ONE header builder for both delivery tiers
+                    # (serve/watch.py): the reactor's notify bytes and
+                    # this thread's are identical by construction
+                    hdrs = watch_mod.delivery_headers(
+                        store, snap, meta, since, ensure_session_id(
+                            self.headers.get(SESSION_HEADER)))
                     if parked:
                         reg.stats.observe_notify(
                             (time.perf_counter() - woke_at) * 1e3)
@@ -390,6 +433,13 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     st, pub_at = "timeout", None
+                elif reactor is not None and not parked \
+                        and self._watch_detach(reactor, doc, reg,
+                                               "poll", since, limit,
+                                               deadline, snap.seq):
+                    # detach seam: the caught-up connection now parks
+                    # on the reactor — this thread returns to the pool
+                    return
                 else:
                     st, pub_at = reg.wait_beyond(snap.seq, remaining)
                 if st == "new":
@@ -402,9 +452,9 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 # timeout heartbeat: an EMPTY wire batch (nothing to
                 # re-send), resume mark unchanged, ETag = the caught-up
                 # window's validator for the next poll's If-None-Match
-                hdrs = self._read_trace_headers(snap)
-                self._since_headers(hdrs, meta)
-                hdrs["ETag"] = meta["etag"]
+                hdrs = watch_mod.delivery_headers(
+                    store, snap, meta, since, ensure_session_id(
+                        self.headers.get(SESSION_HEADER)))
                 hdrs[WATCH_EVENT_HEADER] = "timeout"
                 reg.stats.add("heartbeats")
                 self._send_raw(200, EMPTY_BATCH_BYTES, headers=hdrs)
@@ -436,6 +486,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             hb = max(0.05, reg.heartbeat_s)
             parked, woke_at = False, 0.0
             last_seq = None
+            reactor = getattr(store, "reactor", None)
             while True:
                 snap = doc.snapshot_view()
                 body, meta = snap.ops_since_window(since, limit)
@@ -476,6 +527,14 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     self.wfile.write(b"event: bye\ndata: "
                                      b'{"resume_since": %d}\n\n'
                                      % since)
+                    return
+                if reactor is not None and self._watch_detach(
+                        reactor, doc, reg, "sse", since, limit,
+                        deadline, snap.seq,
+                        hb_deadline=time.monotonic() + hb):
+                    # caught-up stream: the reactor owns it from here
+                    # (per-generation events, : hb keepalives, named
+                    # closes) — this thread returns to the pool
                     return
                 st, pub_at = reg.wait_beyond(
                     snap.seq, min(hb, remaining))
@@ -727,6 +786,7 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                 except WatchClosed as e:
                     self._send(503, {"error": str(e)})
                     return
+                self._watch_detached = False
                 try:
                     if mode == "sse":
                         self._watch_sse(doc, reg, since, limit,
@@ -748,7 +808,11 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     reg.stats.add("reaped")
                     self.close_connection = True
                 finally:
-                    reg.unregister()
+                    if not self._watch_detached:
+                        reg.unregister()
+                    # a detached slot is the reactor's to release:
+                    # its delivery/heartbeat/reap/close unregisters
+                    # with the same lifetime the threaded path had
             elif sub == "/snapshot":
                 try:
                     if hasattr(doc, "read_view"):
@@ -942,9 +1006,15 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
     owned_engine: Optional[ServingEngine] = None
 
+    # reactor-scale ramp (ISSUE 18): thousands of watcher connects can
+    # arrive in one burst; socketserver's default backlog of 5 would
+    # RST the tail of the herd at the kernel accept queue
+    request_queue_size = 128
+
     def __init__(self, *args, **kw):
         self._conn_lock = threading.Lock()
         self._live_conns: set = set()
+        self._detached: set = set()
         super().__init__(*args, **kw)
 
     def process_request(self, request, client_address):
@@ -952,8 +1022,23 @@ class ServingHTTPServer(ThreadingHTTPServer):
             self._live_conns.add(request)
         super().process_request(request, client_address)
 
+    def note_detached(self, request) -> None:
+        """The detach seam (serve/reactor.py): a watch handler hands
+        this connection's socket to the reactor and exits.  From here
+        the reactor owns the socket's lifetime — the handler-thread
+        teardown (``shutdown_request``) must skip the shutdown/close
+        it would otherwise do, and ``server_close`` must not sever it
+        (the engine's ``close()`` drains the reactor with named
+        closes instead)."""
+        with self._conn_lock:
+            self._live_conns.discard(request)
+            self._detached.add(request)
+
     def shutdown_request(self, request):
         with self._conn_lock:
+            if request in self._detached:
+                self._detached.discard(request)
+                return          # the reactor owns this socket now
             self._live_conns.discard(request)
         super().shutdown_request(request)
 
@@ -1003,6 +1088,12 @@ def make_server(port: int = 0, store=None,
     server.store = store
     if owned:
         server.owned_engine = store
+    # reactor egress (serve/reactor.py): the reactor re-injects a
+    # keep-alive connection's NEXT request through the server's
+    # process_request, so it needs the server reference
+    reactor = getattr(store, "reactor", None)
+    if reactor is not None:
+        reactor.server = server
     return server
 
 

@@ -280,7 +280,7 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     mark = int(resp.getheader("X-Since-Next"))
     wd = srv.store.get(wdoc, create=False)
     rc0 = wd.readcache.snapshot()
-    n_watch = 4
+    n_watch = 24
     wresults = {}
 
     def watch_leg(k):
@@ -289,6 +289,7 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
             f"/docs/{wdoc}/watch?since={mark}&limit=100000&timeout=30")
         wresults[k] = (st, raw, resp.getheader("X-Watch-Event"))
 
+    thr0 = threading.active_count()
     wthreads = [threading.Thread(target=watch_leg, args=(k,),
                                  daemon=True, name=f"smoke-watch-{k}")
                 for k in range(n_watch)]
@@ -298,6 +299,22 @@ def run(n_docs: int = 4, writers_per_doc: int = 3, deltas: int = 4,
     while wd.watch.counts()["parked"] < n_watch:
         assert time.monotonic() < deadline, "watchers never parked"
         time.sleep(0.005)
+    # reactor egress (ISSUE 18): with the selector tier on, a parked
+    # watcher holds NO handler thread — the process grew by the
+    # n_watch CLIENT threads above plus at most the reactor's loop
+    # threads, so parked count ≫ server-side thread delta
+    reactor = getattr(srv.store, "reactor", None)
+    if reactor is not None:
+        server_thread_delta = threading.active_count() - thr0 - n_watch
+        assert server_thread_delta <= 6, \
+            (server_thread_delta, n_watch, threading.active_count())
+        rsnap = reactor.snapshot()
+        assert rsnap["parked"] == n_watch, rsnap
+        assert rsnap["threads"] <= 4, rsnap
+        summary["reactor"] = {
+            "parked": rsnap["parked"],
+            "loop_threads": rsnap["threads"],
+            "server_thread_delta": server_thread_delta}
     st, raw = req("POST", f"/docs/{wdoc}/replicas")
     wrid = json.loads(raw)["replica"]
     st, raw = req("POST", f"/docs/{wdoc}/ops",

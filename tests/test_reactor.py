@@ -1,0 +1,672 @@
+"""Reactor egress tier (serve/reactor.py; ISSUE 18).
+
+The contract under test, in rough order of consequence:
+
+- **A/B byte identity.** With a fixed ``X-Session-Id``, every watch
+  delivery class — resume walk across tier seams, park→notify,
+  timeout heartbeat, slow-consumer shed, SSE event frames — produces
+  the SAME wire bytes whether the park is held by a handler thread
+  (``reactor=False``) or by a reactor loop.  The only permitted
+  difference is the ``Date`` header's timestamp.
+- **Partial-write continuation.** A throttled client (small SO_SNDBUF
+  on the listener, small SO_RCVBUF on the client) forces EAGAIN
+  mid-delivery; the reactor re-arms EPOLLOUT and resumes at the exact
+  byte, so the drained body still equals the ``/ops`` reference.
+- **Buffer-lifetime pins.** A publish that swaps the generation while
+  a delivery is still queued must not corrupt the in-flight bytes:
+  the egress buffer pins the snapshot it was encoded from.
+- **Keep-alive re-injection.** After a reactor-written response the
+  socket waits in the reactor; the client's next pipelined request is
+  handed back to a transient handler thread intact.
+- **Reaping.** A parked client that disappears is found by the
+  selector (MSG_PEEK EOF / error) — without waiting for a publish —
+  and its registry slot is released.
+- **Shutdown.** ``engine.close()`` drains every reactor-parked
+  watcher with the same named close the threaded path writes.
+- **Scale pin.** 2k watchers park on the reactor with a flat server
+  thread count (loops ≤ 4) and one publish fans out from a single
+  window encode (readcache misses +1 / hits +(N-1)).
+"""
+
+import contextlib
+import json
+import os
+import re
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from crdt_graph_tpu import engine as engine_mod
+from crdt_graph_tpu.cluster import FleetServer, MemoryKV, NetChaos
+from crdt_graph_tpu.cluster.pool import ConnectionPool
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.oplog import EMPTY_BATCH_BYTES
+from crdt_graph_tpu.serve import ServingEngine
+from crdt_graph_tpu.service import make_server
+
+
+def _ts(r, c):
+    return r * 2**32 + c
+
+
+def _chain(rid, n, start=1, prev=0, pad=0):
+    ops = []
+    for c in range(start, start + n):
+        val = f"r{rid}:{c}" + ("x" * pad if pad else "")
+        ops.append(Add(_ts(rid, c), (prev,), val))
+        prev = _ts(rid, c)
+    return json_codec.dumps(Batch(tuple(ops)))
+
+
+@contextlib.contextmanager
+def _served(**engine_kw):
+    eng = ServingEngine(**engine_kw)
+    srv = make_server(port=0, store=eng)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    pool = ConnectionPool()
+
+    def req(method, path, body=None, headers=None, timeout=60):
+        resp, raw = pool.request(
+            threading.current_thread().name, "server", "127.0.0.1",
+            srv.server_port, method, path, body=body, headers=headers,
+            timeout=timeout)
+        return resp.status, raw, {k: v for k, v in resp.getheaders()}
+
+    try:
+        yield srv, req, eng
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def _read_http(sock, timeout=30.0):
+    """One Content-Length framed response off a raw keep-alive
+    socket: ``(head_bytes, body_bytes)``."""
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        c = sock.recv(65536)
+        if not c:
+            raise ConnectionError("eof before head")
+        buf += c
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    clen = int(re.search(rb"Content-Length: (\d+)", head).group(1))
+    while len(rest) < clen:
+        c = sock.recv(65536)
+        if not c:
+            raise ConnectionError("eof mid body")
+        rest += c
+    return head, rest[:clen]
+
+
+def _send_watch(sock, doc, since, limit, timeout,
+                session="sess-ab-0001", extra=""):
+    sock.sendall(
+        (f"GET /docs/{doc}/watch?since={since}&limit={limit}"
+         f"&timeout={timeout}{extra} HTTP/1.1\r\nHost: t\r\n"
+         f"X-Session-Id: {session}\r\n\r\n").encode())
+
+
+def _norm_head(head):
+    """The ``Date`` stamp is the single permitted A/B difference."""
+    return re.sub(rb"Date: [^\r]+", b"Date: *", head)
+
+
+def _wait_parked(doc, n=1, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while doc.watch.counts()["parked"] < n:
+        assert time.monotonic() < deadline, "never parked"
+        time.sleep(0.005)
+
+
+# -- A/B byte identity -------------------------------------------------------
+
+
+def _ab_poll_leg(reactor_on):
+    """Drive one server through every long-poll delivery class over a
+    single raw keep-alive socket; return the labelled wire bytes."""
+    out = {}
+    with _served(reactor=reactor_on, oplog_hot_ops=16) as \
+            (srv, req, eng):
+        prev = 0
+        for k in range(4):
+            st, raw, _ = req("POST", "/docs/d/ops",
+                             body=_chain(4, 10, start=k * 10 + 1,
+                                         prev=prev))
+            prev = _ts(4, (k + 1) * 10)
+            assert st == 200 and json.loads(raw)["accepted"]
+        assert eng.flush(timeout=60)
+        assert eng.get("d").snapshot_view().log_segments > 1
+
+        s = socket.create_connection(("127.0.0.1", srv.server_port),
+                                     timeout=30)
+        try:
+            # resume walk across the hot→cold seams, to the heartbeat
+            since, rounds = 0, 0
+            while True:
+                _send_watch(s, "d", since, 7, 0.3)
+                head, body = _read_http(s)
+                out[f"walk{rounds}"] = (head, body)
+                ev = re.search(rb"X-Watch-Event: (\w+)",
+                               head).group(1)
+                if ev == b"timeout":
+                    assert body == EMPTY_BATCH_BYTES
+                    break
+                since = int(re.search(rb"X-Since-Next: (\d+)",
+                                      head).group(1))
+                rounds += 1
+                assert rounds < 100
+            # caught-up park -> notify
+            _send_watch(s, "d", since, 100, 10)
+            _wait_parked(eng.get("d"))
+            st, raw, _ = req("POST", "/docs/d/ops",
+                             body=_chain(4, 3, start=41, prev=prev))
+            assert st == 200 and json.loads(raw)["accepted"]
+            out["notify"] = _read_http(s)
+            since = int(re.search(rb"X-Since-Next: (\d+)",
+                                  out["notify"][0]).group(1))
+            # park then fall far behind -> shed with the resume mark
+            _send_watch(s, "d", since, 2, 10)
+            _wait_parked(eng.get("d"))
+            st, raw, _ = req("POST", "/docs/d/ops",
+                             body=_chain(4, 12, start=44,
+                                         prev=_ts(4, 43)))
+            assert st == 200 and json.loads(raw)["accepted"]
+            out["shed"] = _read_http(s)
+        finally:
+            s.close()
+    return out
+
+
+def test_reactor_ab_poll_byte_identity_across_seams():
+    """Every long-poll delivery class — seam-crossing resume walk,
+    notify, timeout heartbeat, shed — is byte-identical between the
+    reactor and the threaded park path, modulo the Date stamp."""
+    a = _ab_poll_leg(True)
+    b = _ab_poll_leg(False)
+    assert a.keys() == b.keys()
+    for leg in a:
+        assert _norm_head(a[leg][0]) == _norm_head(b[leg][0]), leg
+        assert a[leg][1] == b[leg][1], leg
+    # the classes the walk must actually have covered
+    events = b"".join(h for h, _ in a.values())
+    for ev in (b"X-Watch-Event: resume", b"X-Watch-Event: timeout",
+               b"X-Watch-Event: notify", b"X-Watch-Event: shed"):
+        assert ev in events
+
+
+def _ab_sse_leg(reactor_on):
+    with _served(reactor=reactor_on) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        eng.get("d").watch.heartbeat_s = 0.2
+        conn = HTTPConnection("127.0.0.1", srv.server_port,
+                              timeout=30)
+        try:
+            conn.request("GET",
+                         "/docs/d/watch?since=0&limit=1000&mode=sse"
+                         "&timeout=1.0",
+                         headers={"X-Session-Id": "sess-ab-0001"})
+            resp = conn.getresponse()
+            head = {k.lower(): v for k, v in resp.getheaders()
+                    if k.lower() != "date"}
+            time.sleep(0.35)
+            st, raw, _ = req("POST", "/docs/d/ops", body=_chain(2, 2))
+            assert st == 200 and json.loads(raw)["accepted"]
+            raw = resp.read()
+        finally:
+            conn.close()
+    frames = [f for f in raw.split(b"\n\n")
+              if f and not f.startswith(b": hb")]
+    return resp.status, head, frames
+
+
+def test_reactor_ab_sse_frames_identical():
+    """The SSE stream's event frames (backlog, live commit, named
+    goodbye) and response head match the threaded path exactly;
+    only the comment-heartbeat cadence may drift."""
+    sa, ha, fa = _ab_sse_leg(True)
+    sb, hb, fb = _ab_sse_leg(False)
+    assert (sa, ha) == (sb, hb)
+    assert fa == fb
+    kinds = [re.search(rb"event: (\w+)", f).group(1) for f in fa]
+    assert kinds[0] == b"ops" and kinds[-1] == b"bye"
+    assert kinds.count(b"ops") == 2
+
+
+# -- partial-write continuation + pin integrity ------------------------------
+
+
+def _throttled_park(srv, req, eng, since, pad_posts):
+    """A tiny-window client parked caught-up, then fed fat publishes:
+    returns the raw socket mid-partial-write."""
+    srv.socket.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 16384)
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+    s.connect(("127.0.0.1", srv.server_port))
+    _send_watch(s, "d", since, 2000, 20)
+    _wait_parked(eng.get("d"))
+    for body in pad_posts:
+        st, raw, _ = req("POST", "/docs/d/ops", body=body)
+        assert st == 200 and json.loads(raw)["accepted"]
+    return s
+
+
+def test_reactor_partial_write_continuation_throttled_client():
+    """A window much larger than the socket buffers is delivered in
+    EAGAIN-interrupted pieces; the drained body still equals the
+    ``/ops`` reference byte for byte, and the continuation counter
+    proves the slow path actually ran."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        fat = _chain(2, 300, pad=1024)
+        s = _throttled_park(srv, req, eng, mark, [fat])
+        try:
+            time.sleep(0.3)          # let the reactor hit EAGAIN
+            st, ref, _ = req("GET",
+                             f"/docs/d/ops?since={mark}&limit=2000")
+            assert st == 200
+            head, body = _read_http(s, timeout=60)
+            assert b"X-Watch-Event: notify" in head
+            assert body == ref
+        finally:
+            s.close()
+        snap = eng.reactor.snapshot()
+        assert snap["partial_writes"] >= 1
+        assert snap["buf_hw"] > 16384
+
+
+def test_reactor_pin_survives_publish_swap_mid_write():
+    """A second publish lands while the first delivery is still
+    queued behind a throttled socket: the egress buffer's snapshot
+    pin keeps the in-flight bytes valid, and the follow-up poll on
+    the same keep-alive socket resumes exactly."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, full0, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        fat = _chain(2, 300, pad=1024)
+        s = _throttled_park(srv, req, eng, mark, [fat])
+        try:
+            time.sleep(0.2)
+            # the reference for the IN-FLIGHT window, then swap the
+            # generation underneath it (window LRU may evict, the shm
+            # body cache may remap — the pin must hold regardless)
+            st, ref1, _ = req("GET",
+                              f"/docs/d/ops?since={mark}&limit=2000")
+            assert st == 200
+            st, raw, _ = req("POST", "/docs/d/ops",
+                             body=_chain(3, 200, pad=512))
+            assert st == 200 and json.loads(raw)["accepted"]
+            head, body = _read_http(s, timeout=60)
+            assert body == ref1
+            nxt = int(re.search(rb"X-Since-Next: (\d+)",
+                                head).group(1))
+            # keep-alive re-injection: the next poll on the SAME
+            # socket walks the rest of the log.  Bootstrap order
+            # matters: windows redeliver their last Add as the resume
+            # terminator, absorbed only once the prefix is applied.
+            replica = engine_mod.init(0)
+            replica.apply(json_codec.loads(full0))
+            replica.apply(json_codec.loads(ref1))
+            since = nxt
+            for _ in range(50):
+                _send_watch(s, "d", since, 2000, 0.3)
+                h2, b2 = _read_http(s, timeout=60)
+                if b"X-Watch-Event: timeout" in h2:
+                    break
+                replica.apply(json_codec.loads(b2))
+                since = int(re.search(rb"X-Since-Next: (\d+)",
+                                      h2).group(1))
+            else:
+                pytest.fail("never caught up after swap")
+        finally:
+            s.close()
+        st, raw, _ = req("GET", "/docs/d")
+        assert replica.visible_values() == json.loads(raw)["values"]
+        assert eng.reactor.snapshot()["partial_writes"] >= 1
+
+
+# -- keep-alive re-injection + heartbeat re-park -----------------------------
+
+
+def test_reactor_heartbeat_reinjects_and_reparks():
+    """timeout heartbeat → the socket waits in the reactor → the next
+    request on the same connection is re-injected into a handler
+    thread, parks again, and the publish notify lands on it."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        s = socket.create_connection(("127.0.0.1", srv.server_port),
+                                     timeout=30)
+        try:
+            _send_watch(s, "d", mark, 100, 0.3)
+            head, body = _read_http(s)
+            assert b"X-Watch-Event: timeout" in head
+            assert body == EMPTY_BATCH_BYTES
+            # the client can hold the response bytes a beat before the
+            # reactor thread finishes its release bookkeeping (GIL
+            # scheduling on a 1-core host): wait for the slot drop so
+            # the re-park below is unambiguously the SECOND park
+            deadline = time.monotonic() + 10
+            while eng.get("d").watch.counts()["registered"] > 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            _send_watch(s, "d", mark, 100, 10)
+            _wait_parked(eng.get("d"))
+            deadline = time.monotonic() + 10
+            while eng.reactor.snapshot()["reinjects"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            st, raw, _ = req("POST", "/docs/d/ops", body=_chain(2, 2))
+            assert st == 200 and json.loads(raw)["accepted"]
+            head, body = _read_http(s)
+            assert b"X-Watch-Event: notify" in head
+            st, ref, _ = req("GET",
+                             f"/docs/d/ops?since={mark}&limit=100")
+            assert body == ref
+        finally:
+            s.close()
+        deadline = time.monotonic() + 10
+        while eng.get("d").watch.counts()["registered"] > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+
+# -- reaping -----------------------------------------------------------------
+
+
+def test_reactor_reaps_closed_clients_without_a_publish():
+    """The selector notices a dead parked client on its own — FIN or
+    RST — and frees the slot with no publish to flush it out (the
+    threaded path only discovers the corpse at write time)."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        socks = []
+        for _ in range(2):
+            s = socket.create_connection(
+                ("127.0.0.1", srv.server_port), timeout=10)
+            _send_watch(s, "d", mark, 100, 30)
+            socks.append(s)
+        doc = eng.get("d")
+        _wait_parked(doc, n=2)
+        # one RST, one FIN — both must reap
+        socks[0].setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        socks[0].close()
+        socks[1].close()
+        deadline = time.monotonic() + 10
+        while doc.watch.counts()["registered"] > 0:
+            assert time.monotonic() < deadline, "slots never freed"
+            time.sleep(0.01)
+        assert eng.reactor.snapshot()["reaps"] == 2
+        assert doc.watch.stats.snapshot()["reaped"] == 2
+
+
+# -- shutdown drains with named closes ---------------------------------------
+
+
+def test_reactor_shutdown_writes_named_closes():
+    """``engine.close()`` drains every reactor-parked watcher — long
+    polls answer the 503 ``X-Watch-Event: closed``, SSE streams get
+    ``event: closed`` — before the loops join."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        polls = []
+        for _ in range(2):
+            s = socket.create_connection(
+                ("127.0.0.1", srv.server_port), timeout=10)
+            _send_watch(s, "d", mark, 100, 30)
+            polls.append(s)
+        sse = socket.create_connection(
+            ("127.0.0.1", srv.server_port), timeout=10)
+        _send_watch(sse, "d", mark, 100, 30, extra="&mode=sse")
+        _wait_parked(eng.get("d"), n=3)
+        eng.close()
+        for s in polls:
+            head, body = _read_http(s)
+            assert b"HTTP/1.1 503" in head
+            assert b"X-Watch-Event: closed" in head
+            assert json.loads(body) == {"error": "engine shutting down"}
+            s.close()
+        sse.settimeout(10)
+        raw = b""
+        while True:
+            c = sse.recv(65536)
+            if not c:
+                break
+            raw += c
+        sse.close()
+        assert b"event: closed\ndata: {}\n\n" in raw
+        assert eng.reactor.snapshot()["closes"] == 3
+
+
+# -- churn under chaos -------------------------------------------------------
+
+
+def test_reactor_watch_under_netchaos_churn_exact_resume():
+    """The fleet churn leg with the reactor holding the parks: chaos
+    delays/duplicates/cuts the inter-node pulls while a reconnecting
+    watcher on the non-primary resumes with its mark — zero acked
+    writes lost, and the parks actually rode the reactor."""
+    chaos = NetChaos(31, "delay=1-6@0.4;dup=0.3;cut=0.2")
+    kv = MemoryKV()
+    fleet = {}
+    for n in ("a", "b"):
+        fleet[n] = FleetServer(n, kv, ttl_s=600.0,
+                               ae_interval_s=3600.0, netchaos=chaos)
+    for fs in fleet.values():
+        fs.node.refresh_ring()
+    try:
+        ring = fleet["a"].node.ring()
+        doc = next(f"w{i}" for i in range(500)
+                   if ring.primary(f"w{i}") == "a")
+
+        def fleet_req(port, method, path, body=None, headers=None):
+            conn = HTTPConnection("127.0.0.1", port, timeout=30)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                return resp.status, resp.read(), \
+                    dict(resp.getheaders())
+            finally:
+                conn.close()
+
+        stop = threading.Event()
+        state = {"mark": 0, "errors": []}
+        replica = engine_mod.init(0)
+
+        def watcher():
+            while not stop.is_set():
+                try:
+                    st, raw, hdr = fleet_req(
+                        fleet["b"].port, "GET",
+                        f"/docs/{doc}/watch?since={state['mark']}"
+                        f"&limit=8192&timeout=0.3")
+                except OSError as e:
+                    state["errors"].append(repr(e))
+                    return
+                if st in (404, 503):
+                    time.sleep(0.01)
+                    continue
+                if st != 200:
+                    state["errors"].append(f"watch -> {st}")
+                    return
+                if hdr["X-Watch-Event"] == "timeout":
+                    continue
+                replica.apply(json_codec.loads(raw))
+                state["mark"] = int(hdr["X-Since-Next"])
+
+        t = threading.Thread(target=watcher, daemon=True,
+                             name="chaos-watch")
+        t.start()
+        prev = 0
+        for k in range(4):
+            st, raw, _ = fleet_req(
+                fleet["a"].port, "POST", f"/docs/{doc}/ops",
+                body=_chain(3, 15, start=k * 15 + 1, prev=prev))
+            prev = _ts(3, (k + 1) * 15)
+            assert st == 200, raw
+            for _ in range(50):
+                if fleet["b"].node.antientropy.sync_now() == \
+                        {"a": True}:
+                    break
+            else:
+                pytest.fail(f"sync never healed: {chaos.describe()}")
+        st, raw, hdr = fleet_req(
+            fleet["b"].port, "GET",
+            f"/docs/{doc}/ops?since=0&limit=100000")
+        final_mark = int(hdr["X-Since-Next"])
+        deadline = time.monotonic() + 15
+        while state["mark"] != final_mark:
+            assert time.monotonic() < deadline, \
+                (state, final_mark, chaos.describe())
+            time.sleep(0.05)
+        stop.set()
+        t.join(30)
+        assert state["errors"] == [], state["errors"]
+        st, raw, _ = fleet_req(fleet["b"].port, "GET", f"/docs/{doc}")
+        served = json.loads(raw)["values"]
+        assert replica.visible_values() == served
+        assert len(served) == 60          # zero acked-write loss
+        # the caught-up parks between generations rode the reactor
+        assert fleet["b"].node.engine.reactor.snapshot()[
+            "detached"] >= 1
+    finally:
+        for fs in fleet.values():
+            try:
+                fs.stop()
+            except Exception:  # noqa: BLE001 — teardown boundary
+                pass
+
+
+# -- observability gating ----------------------------------------------------
+
+
+def test_reactor_prom_families_present_and_gated():
+    """``crdt_reactor_*`` renders under the strict-parse contract
+    when the reactor runs, and the families are entirely ABSENT when
+    the threaded path is selected — the exposition is the A/B gate."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=100")
+        mark = int(hdr["X-Since-Next"])
+        s = socket.create_connection(("127.0.0.1", srv.server_port),
+                                     timeout=10)
+        try:
+            _send_watch(s, "d", mark, 100, 10)
+            _wait_parked(eng.get("d"))
+            text = prom_mod.render_engine(eng)
+            fams = prom_mod.parse_text(text)
+            assert fams["crdt_reactor_parked"]["samples"][0][2] == 1
+            assert "crdt_reactor_detached_total" in fams
+            assert fams["crdt_reactor_sheds_total"]["samples"][0][1] \
+                == {"reason": "buffer"}
+            assert fams["crdt_reactor_threads"]["samples"][0][2] >= 1
+        finally:
+            s.close()
+    with _served(reactor=False) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        text = prom_mod.render_engine(eng)
+        fams = prom_mod.parse_text(text)
+        assert not any(n.startswith("crdt_reactor_") for n in fams)
+
+
+# -- the scale pin -----------------------------------------------------------
+
+
+N_SCALE = int(os.environ.get("GRAFT_TEST_WATCHERS", "2000"))
+
+
+def test_reactor_parks_2k_watchers_flat_threads():
+    """The headline mechanism at tier-1 scale: 2k watchers parked on
+    ≤4 reactor loops with a flat server thread count, and one publish
+    fans out to all of them from a SINGLE window encode — readcache
+    misses +1, hits +(N-1), every body identical."""
+    with _served(reactor=True) as (srv, req, eng):
+        st, raw, _ = req("POST", "/docs/d/ops", body=_chain(1, 3))
+        assert st == 200 and json.loads(raw)["accepted"]
+        st, _, hdr = req("GET", "/docs/d/ops?since=0&limit=50")
+        mark = int(hdr["X-Since-Next"])
+        doc = eng.get("d")
+        doc.watch.max_watchers = max(doc.watch.max_watchers, N_SCALE)
+
+        socks = []
+        try:
+            for base in range(0, N_SCALE, 100):
+                burst = []
+                for i in range(base, min(base + 100, N_SCALE)):
+                    s = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+                    s.connect(("127.0.0.1", srv.server_port))
+                    _send_watch(s, "d", mark, 100, 120,
+                                session=f"w-{i:04d}")
+                    burst.append(s)
+                socks.extend(burst)
+                # pace the herd: let this burst park before the next
+                # slams the accept queue (request_queue_size=128)
+                _wait_parked(doc, n=len(socks), timeout=60)
+
+            assert doc.watch.counts()["parked"] == N_SCALE
+            assert doc.watch.counts()["reactor_parked"] == N_SCALE
+            rsnap = eng.reactor.snapshot()
+            assert rsnap["threads"] <= 4
+            assert rsnap["parked"] == N_SCALE
+
+            # handler threads are transient: once every park has
+            # detached, the server's thread population must be FLAT —
+            # loops + acceptor + scheduler noise, nowhere near N
+            deadline = time.monotonic() + 30
+            while threading.active_count() > 24:
+                assert time.monotonic() < deadline, \
+                    f"threads never drained: {threading.active_count()}"
+                time.sleep(0.05)
+
+            rc0 = doc.readcache.snapshot()
+            st, raw, _ = req("POST", "/docs/d/ops", body=_chain(2, 4))
+            assert st == 200 and json.loads(raw)["accepted"]
+            bodies = set()
+            for s in socks:
+                head, body = _read_http(s, timeout=120)
+                assert b"X-Watch-Event: notify" in head
+                bodies.add(body)
+            assert len(bodies) == 1      # one window, N deliveries
+            rc1 = doc.readcache.snapshot()
+            assert rc1["misses"] - rc0["misses"] == 1
+            assert rc1["hits"] - rc0["hits"] == N_SCALE - 1
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 30
+        while doc.watch.counts()["registered"] > 0:
+            assert time.monotonic() < deadline, "registry never drained"
+            time.sleep(0.05)
