@@ -843,6 +843,10 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         "fsync_stall": _stage_stats(("wal_fsync_queued",
                                      "wal_fsync")),
         "wal_append": _stage_stats(("wal_append",)),
+        # disaggregated merge tier (docs/MERGETIER.md): round-trip to
+        # the worker pool per remote-routed commit (None when the tier
+        # is off or nothing routed)
+        "remote_merge": _stage_stats(("remote_merge",)),
         # which group-commit sync lane produced these numbers (ISSUE
         # 17): the A/B legs label the breakdown with the backend that
         # actually RAN (auto-detect may downgrade a requested uring),
@@ -938,6 +942,12 @@ def _run(cfg: LoadgenConfig, engine: ServingEngine,
         # the last routed shape ({devices, shard_width, halo_rows,
         # collective_bytes, leg}), chain_audit-style and never fatal
         "opsaxis": _opsaxis_report(),
+        # disaggregated merge tier (docs/MERGETIER.md): route/fallback
+        # counters, worker pool health, achieved widths — None when
+        # the tier is off (the A/B legs key off exactly this)
+        "mergetier": (engine.mergetier.stats()
+                      if getattr(engine, "mergetier", None)
+                      is not None else None),
     }
     return out
 

@@ -1,0 +1,37 @@
+"""Disaggregated merge tier: pooled cross-doc batched merge workers
+serving thin replica front-ends (docs/MERGETIER.md).
+
+Every replica used to weld HTTP + WAL + scheduler + kernel into one
+process, so the vmapped cross-doc launch (parallel/mesh.py
+``stack_aligned`` + ``batched_materialize``) only ever batched the
+documents that happened to arrive at ONE process.  This package splits
+the replica: serving **front-ends** keep admission/ack/WAL/read-cache/
+watch/anti-entropy, while the kernel launch for giant and coalescible
+merges ships to a pooled **merge tier** that accumulates candidate
+sets across the WHOLE fleet's traffic inside a
+``GRAFT_MERGETIER_BATCH_MS`` linger window and materializes them as
+one batched launch — utilization scales with fleet size instead of
+per-replica arrival luck.
+
+- :mod:`.wire` — the packed-npz ``POST /merge`` request/response codec
+  with end-to-end digests (the fingerprint-verify protocol's transport
+  half).
+- :mod:`.worker` — the merge worker: linger batcher + one vmapped
+  launch per epoch; serves ``/merge`` behind ``service.http`` or is
+  called directly (the in-process transport twin tier-1 pins
+  remote-vs-local bit-identity with).
+- :mod:`.client` — the front-end's client: route thresholds, worker
+  selection, per-worker circuit breakers, the end-to-end budget, the
+  dry-check, and the fallback ladder (any failure → the bit-identical
+  local merge; ``GRAFT_MERGETIER=0`` is the A/B kill switch).
+
+Worker registration rides the cluster lease KV under the ring-
+independent ``mergeworker/`` prefix (cluster/mergepool.py) — workers
+are a pooled resource, never ring members.
+"""
+from .client import MergeTierClient, tier_enabled, route_min_ops
+from .wire import MergeWireError
+from .worker import MergeWorker
+
+__all__ = ["MergeTierClient", "MergeWorker", "MergeWireError",
+           "tier_enabled", "route_min_ops"]

@@ -1,0 +1,216 @@
+"""The merge worker: the tier's compute half.
+
+One worker process owns the accelerator and does exactly one thing:
+accumulate ``/merge`` requests — each a document's prepared candidate
+set, from ANY front-end in the fleet — inside a
+:class:`~crdt_graph_tpu.parallel.mesh.LingerBatcher` window
+(``GRAFT_MERGETIER_BATCH_MS`` linger, ``GRAFT_MERGETIER_MAX_WIDTH``
+cap), then materialize the whole epoch as ONE ``stack_aligned`` +
+``batched_materialize`` launch and hand each requester its own
+document's slice of the batched table.  The worker is stateless per
+request (the candidate set arrives complete), so workers are a POOL:
+any request may go to any live worker, death loses no state, and the
+front-end's fallback ladder (mergetier/client.py) makes every failure
+mode a local merge instead of a failed write.
+
+Served two ways, same handler:
+
+- over HTTP — ``service.http`` routes ``POST /merge`` to any store
+  exposing :meth:`MergeWorker.handle_merge`; :class:`MergeWorkerServer`
+  is the process twin of ``cluster.gateway.FleetServer``;
+- in process — the transport twin: tests (and single-process
+  deployments) hand the :class:`MergeWorker` itself to the client,
+  which calls :meth:`handle_merge` directly.  Same bytes, same codec,
+  same batcher — the tier-1 bit-identity pin runs THIS path.
+
+Like every scheduler, the launch runs on ONE thread at a time (the
+epoch leader's); the batcher serializes epochs, so the one-thread-
+owns-JAX invariant holds no matter how many HTTP handler threads park
+in :meth:`handle_merge`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..serve.metrics import Histogram
+from ..utils.hostenv import env_float as _env_float
+from ..utils.hostenv import env_int as _env_int
+from . import wire
+
+DEFAULT_BATCH_MS = 2.0
+DEFAULT_MAX_WIDTH = 16
+# achieved cross-doc launch width (the headline distribution)
+WIDTH_BOUNDS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+
+
+class MergeWorker:
+    """Pooled merge compute behind ``POST /merge`` (docs/MERGETIER.md)."""
+
+    def __init__(self, linger_ms: Optional[float] = None,
+                 max_width: Optional[int] = None,
+                 name: str = "mergeworker"):
+        from ..parallel import mesh as mesh_mod
+        self.name = name
+        if linger_ms is None:
+            linger_ms = _env_float("GRAFT_MERGETIER_BATCH_MS",
+                                   DEFAULT_BATCH_MS)
+        if max_width is None:
+            max_width = _env_int("GRAFT_MERGETIER_MAX_WIDTH",
+                                 DEFAULT_MAX_WIDTH)
+        self.batcher = mesh_mod.LingerBatcher(
+            self._launch, linger_s=max(0.0, linger_ms) / 1e3,
+            max_width=max_width)
+        self.width_hist = Histogram(WIDTH_BOUNDS)
+        self._meshes: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._dead = False
+        self.requests = 0
+        self.merged_docs = 0
+        self.merged_ops = 0
+        self.wire_errors = 0
+        self.launch_errors = 0
+
+    # -- the epoch launch (leader thread) ---------------------------------
+
+    def _mesh_for(self, b: int):
+        """Largest doc-axis divisor of ``b`` that fits the device count
+        (same rule as the serving scheduler's local grouped launch)."""
+        import jax
+        from ..parallel import mesh as mesh_mod
+        ndev = len(jax.devices())
+        n_docs = max(d for d in range(1, min(b, ndev) + 1) if b % d == 0)
+        with self._lock:
+            m = self._meshes.get(n_docs)
+            if m is None:
+                m = self._meshes[n_docs] = mesh_mod.make_mesh(n_docs, 1)
+        return m
+
+    def _launch(self, cargo: List[Tuple[object, Dict]]):
+        """One epoch: align every request's candidate set to the shared
+        capacity, stack, materialize ONCE, slice per document.  Results
+        come back as host numpy — the wire format either way, and the
+        slice must not pin the whole batched table in device memory."""
+        import jax
+        from ..parallel import mesh as mesh_mod
+        prepared = [p for p, _ in cargo]
+        stacked, aligned = mesh_mod.stack_aligned(prepared)
+        btab = mesh_mod.batched_materialize(
+            stacked, self._mesh_for(len(cargo)))
+        host = jax.tree.map(np.asarray, jax.device_get(btab))
+        width = len(cargo)
+        shared = aligned[0].capacity
+        self.width_hist.observe(width)
+        out = []
+        for i in range(width):
+            table = jax.tree.map(lambda a, i=i: a[i], host)
+            out.append((table, shared, width))
+        return out
+
+    # -- the request surface (handler threads) ----------------------------
+
+    def handle_merge(self, body: bytes) -> Tuple[int, bytes, Dict]:
+        """The ``POST /merge`` handler: decode → ride the linger window
+        → encode this document's slice.  Returns ``(status, body,
+        headers)`` exactly like the fleet forward path, so the HTTP
+        layer and the in-process transport serve identical bytes."""
+        if self._dead:
+            # simulated worker death (tests; a real dead worker just
+            # stops answering) — the client's transport error path
+            return 503, json.dumps(
+                {"error": "merge worker shutting down"}).encode(), \
+                {"Content-Type": "application/json"}
+        try:
+            p, meta = wire.decode_request(body)
+        except wire.MergeWireError as e:
+            with self._lock:
+                self.wire_errors += 1
+            return 400, json.dumps({"error": str(e)}).encode(), \
+                {"Content-Type": "application/json"}
+        with self._lock:
+            self.requests += 1
+        try:
+            table, shared, width = self.batcher.submit((p, meta))
+        except Exception as e:   # noqa: BLE001 — a failed epoch must
+            # answer every rider (the front-ends fall back locally);
+            # CrashPoint is a BaseException and still propagates
+            with self._lock:
+                self.launch_errors += 1
+            return 500, json.dumps(
+                {"error": f"batched launch failed: {e!r}"}).encode(), \
+                {"Content-Type": "application/json"}
+        with self._lock:
+            self.merged_docs += 1
+            self.merged_ops += p.num_ops
+        resp = wire.encode_response(table, shared, width,
+                                    meta["input_digest"])
+        return 200, resp, {"Content-Type": "application/octet-stream"}
+
+    # -- lifecycle / telemetry --------------------------------------------
+
+    def crash(self) -> None:
+        """Simulate worker death: every later request answers 503 (the
+        in-process twin of killing the worker process mid-run)."""
+        self._dead = True
+        self.batcher.close()
+
+    def close(self) -> None:
+        self._dead = True
+        self.batcher.close()
+
+    def render_prom(self) -> str:
+        """``GET /metrics/prom`` on a worker server (service/http.py
+        dispatches on this attribute) — the worker-side
+        ``crdt_mergetier_worker_*`` families, linger occupancy
+        included."""
+        from ..obs import prom as prom_mod
+        return prom_mod.render_merge_worker(self)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            out = {"name": self.name,
+                   "requests": self.requests,
+                   "merged_docs": self.merged_docs,
+                   "merged_ops": self.merged_ops,
+                   "wire_errors": self.wire_errors,
+                   "launch_errors": self.launch_errors,
+                   "dead": self._dead}
+        out["batch_width"] = self.width_hist.export()
+        out["batcher"] = self.batcher.stats()
+        return out
+
+
+class MergeWorkerServer:
+    """One merge worker behind a real HTTP server (the process shape;
+    ``FleetServer``'s thin twin).  ``service.http.make_handler`` routes
+    ``POST /merge`` here because the worker exposes ``handle_merge``;
+    every other route 404s — a worker is not a replica."""
+
+    def __init__(self, worker: Optional[MergeWorker] = None,
+                 port: int = 0):
+        import threading as _threading
+
+        from ..service import make_server
+        self.worker = worker if worker is not None else MergeWorker()
+        self.server = make_server(port=port, store=self.worker)
+        self.port = self.server.server_port
+        self.addr = f"127.0.0.1:{self.port}"
+        self._thread = _threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.worker.close()
+        self._thread.join(10)
+
+    def crash(self) -> None:
+        """Kill the serving loop without draining — the netchaos/death
+        legs' worker-side severance."""
+        self.worker.crash()
+        self.server.shutdown()
+        self.server.server_close()

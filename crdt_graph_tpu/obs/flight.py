@@ -81,6 +81,10 @@ class CommitRecord:
     staleness_s: Optional[float]  # previous snapshot's age at publish
     snapshot_seq: Optional[int]
     fingerprint: Optional[str]
+    # batched-launch width the commit rode in: local cross-doc group
+    # size, or the merge worker's achieved cross-fleet width
+    # (docs/MERGETIER.md); None for per-document merges
+    batch_width: Optional[int] = None
     audit: Optional[Dict[str, Any]] = None
     error: Optional[str] = None
     # persisted materialization (docs/DURABILITY.md §Cold paths):

@@ -679,6 +679,49 @@ def render_engine(engine) -> str:
     w.counter("crdt_opsaxis_routed_ops_total",
               "Candidate-set rows merged through the sharded kernel",
               ax["routed_ops"])
+    # -- disaggregated merge tier (mergetier/; docs/MERGETIER.md) ---------
+    # rendered ONLY when a client is armed — GRAFT_MERGETIER=0 (or no
+    # workers) leaves the scrape byte-identical to the local-only engine
+    mergetier = getattr(engine, "mergetier", None)
+    if mergetier is not None:
+        mst = mergetier.stats()
+        w.gauge("crdt_mergetier_workers",
+                "Merge workers in this front-end's pool",
+                len(mst["workers"]))
+        w.gauge("crdt_mergetier_workers_open",
+                "Pool members whose circuit breaker is open",
+                sum(1 for ws in mst["workers"] if ws["breaker_open"]))
+        w.counter("crdt_mergetier_breaker_opens_total",
+                  "Worker breaker open transitions",
+                  sum(ws["breaker_opens"] for ws in mst["workers"]))
+        w.counter("crdt_mergetier_rounds_total",
+                  "Scheduler rounds shipped to the merge tier",
+                  mst["remote_rounds"])
+        w.counter("crdt_mergetier_remote_docs_total",
+                  "Document commits whose frame a merge worker "
+                  "materialized", mst["remote_docs"])
+        w.counter("crdt_mergetier_remote_ops_total",
+                  "Delta rows committed off remote-materialized "
+                  "frames", mst["remote_ops"])
+        w.family("crdt_mergetier_fallbacks_total", "counter",
+                 "Remote merges that fell back to the bit-identical "
+                 "local path, by ladder rung")
+        for reason, cnt in sorted(mst["fallbacks"].items()):
+            w.sample("crdt_mergetier_fallbacks_total",
+                     "crdt_mergetier_fallbacks_total", cnt,
+                     {"reason": reason})
+        for hname, hkey, htext in (
+                ("crdt_mergetier_batch_width", "width",
+                 "Worker-reported cross-fleet launch width each "
+                 "remote commit rode in"),
+                ("crdt_mergetier_remote_ms", "remote_ms",
+                 "Remote merge round-trip latency (encode to "
+                 "verified frame)")):
+            h = mst[hkey]
+            if h and h.get("count"):
+                w.family(hname, "histogram", htext)
+                w.histogram(hname, htext, h["bounds"], h["counts"],
+                            h["count"], h["sum"])
     maint = getattr(engine, "maintenance", None)
     if maint is not None:
         ms = maint.stats()
@@ -784,6 +827,57 @@ def render_engine(engine) -> str:
         w.gauge("crdt_oracle_pending_writes",
                 "Acked writes awaiting commit-record resolution",
                 ost["pending_writes"])
+    return w.render()
+
+
+def render_merge_worker(worker) -> str:
+    """The ``crdt_mergetier_worker_*`` families for one merge worker
+    process (``GET /metrics/prom`` on a worker server — same naming
+    contract and strict parser as the engine scrape).  The linger
+    batcher's occupancy and launch-width distribution live HERE: the
+    worker is the only process that sees the cross-fleet batch."""
+    w = _Writer()
+    st = worker.stats()
+    w.gauge("crdt_mergetier_worker_up",
+            "0 after crash()/close(): the worker answers 503",
+            0.0 if st["dead"] else 1.0)
+    w.counter("crdt_mergetier_worker_requests_total",
+              "Decoded /merge requests admitted to the batcher",
+              st["requests"])
+    w.counter("crdt_mergetier_worker_merged_docs_total",
+              "Documents materialized and answered", st["merged_docs"])
+    w.counter("crdt_mergetier_worker_merged_ops_total",
+              "Delta rows across answered documents", st["merged_ops"])
+    w.counter("crdt_mergetier_worker_wire_errors_total",
+              "Requests rejected by the wire codec (400s)",
+              st["wire_errors"])
+    w.counter("crdt_mergetier_worker_launch_errors_total",
+              "Requests failed by a failed epoch launch (500s)",
+              st["launch_errors"])
+    b = st["batcher"]
+    w.counter("crdt_mergetier_worker_launches_total",
+              "Batched epoch launches", b["launches"])
+    w.counter("crdt_mergetier_worker_full_launches_total",
+              "Epochs launched early at the max-width cap",
+              b["full_launches"])
+    w.counter("crdt_mergetier_worker_linger_waits_total",
+              "Epoch leaders that lingered the full window",
+              b["linger_waits"])
+    w.gauge("crdt_mergetier_worker_linger_occupancy",
+            "Requests riding the CURRENT linger window", b["pending"])
+    w.gauge("crdt_mergetier_worker_linger_ms",
+            "Configured linger window (GRAFT_MERGETIER_BATCH_MS)",
+            b["linger_ms"])
+    w.gauge("crdt_mergetier_worker_max_width",
+            "Configured launch-width cap (GRAFT_MERGETIER_MAX_WIDTH)",
+            b["max_width"])
+    h = st["batch_width"]
+    if h and h.get("count"):
+        w.family("crdt_mergetier_worker_batch_width", "histogram",
+                 "Achieved cross-fleet docs per epoch launch")
+        w.histogram("crdt_mergetier_worker_batch_width",
+                    "Achieved cross-fleet docs per epoch launch",
+                    h["bounds"], h["counts"], h["count"], h["sum"])
     return w.render()
 
 

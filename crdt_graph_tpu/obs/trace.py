@@ -131,7 +131,8 @@ class CommitTrace:
                  "parse_ms", "queue_depth_admission", "stages_ms",
                  "chunk_count", "applied_ops", "dup_ops", "outcome",
                  "staleness_s", "total_ms", "error", "packed",
-                 "wal_deferred", "audit_sampled", "audit_result")
+                 "wal_deferred", "audit_sampled", "audit_result",
+                 "batch_width")
 
     def __init__(self, doc_id: str, tickets) -> None:
         self.doc_id = doc_id
@@ -168,6 +169,10 @@ class CommitTrace:
         # uses the stored result instead of sampling inline
         self.audit_sampled = False
         self.audit_result: Optional[Dict] = None
+        # batched-launch width this commit rode in (local cross-doc
+        # group size, or the merge worker's achieved cross-FLEET width
+        # — docs/MERGETIER.md); None for per-document merges
+        self.batch_width: Optional[int] = None
 
     @contextlib.contextmanager
     def stage(self, name: str, span_name: Optional[str] = None):

@@ -37,7 +37,9 @@ both are pinned bit-identical to the single-device kernel.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -187,6 +189,132 @@ def stack_aligned(batches: Sequence[PackedOps]
     shared = max(p.capacity for p in batches)
     aligned = [with_capacity(p, shared) for p in batches]
     return stack_packed(aligned), aligned
+
+
+class LingerBatcher:
+    """Cross-caller batch accumulation window for the vmapped launch
+    (the merge tier's coalescing heart — mergetier/worker.py).
+
+    Many threads each hold ONE item (a document's prepared candidate
+    set) and want it materialized; a wide ``batched_materialize``
+    amortizes launch overhead across all of them, but only if their
+    arrivals meet in the same launch.  :meth:`submit` parks the caller
+    while a shared window fills: the FIRST arrival becomes the epoch's
+    leader and waits up to ``linger_s`` (``GRAFT_MERGETIER_BATCH_MS``)
+    for co-travellers, launching early the moment ``max_width`` items
+    are aboard; everyone else rides, and every submitter gets exactly
+    its own item's result back.  A failed launch fails the WHOLE
+    epoch's submitters (each caller falls back on its own — for the
+    merge tier that is the front-end's bit-identical local merge).
+
+    ``launch`` receives the epoch's item list and must return one
+    result per item, in order.  It runs on the leader's thread; the
+    batcher itself never touches JAX, so the one-thread-owns-JAX
+    serving invariant is the launch callable's business, not ours.
+    """
+
+    def __init__(self, launch: Callable[[List[Any]], List[Any]],
+                 linger_s: float = 0.002, max_width: int = 16):
+        self._launch = launch
+        self.linger_s = max(0.0, float(linger_s))
+        self.max_width = max(1, int(max_width))
+        self._cv = threading.Condition()
+        self._epoch = 0
+        self._items: List[Any] = []          # current epoch's cargo
+        self._done: Dict[int, tuple] = {}    # epoch -> (results, error)
+        self._riders: Dict[int, int] = {}    # epoch -> riders not yet woken
+        self._closed = False
+        # telemetry (read under the cv by stats())
+        self.launches = 0
+        self.items_in = 0
+        self.full_launches = 0               # width cap hit (no linger)
+        self.linger_waits = 0                # epochs that waited the window
+
+    def submit(self, item: Any) -> Any:
+        """Park until this item's epoch launches; returns its result.
+        Raises whatever the epoch's launch raised (every rider sees the
+        same error) or ``RuntimeError`` after :meth:`close`."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            epoch = self._epoch
+            self._items.append(item)
+            self.items_in += 1
+            index = len(self._items) - 1
+            leader = index == 0
+            if not leader and len(self._items) >= self.max_width:
+                # cap reached: wake the lingering leader early
+                self._cv.notify_all()
+            if leader:
+                deadline = time.monotonic() + self.linger_s
+                waited = False
+                while (len(self._items) < self.max_width
+                       and not self._closed):
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        break
+                    waited = True
+                    self._cv.wait(remain)
+                cargo, self._items = self._items, []
+                self._epoch += 1
+                if waited:
+                    self.linger_waits += 1
+                if len(cargo) >= self.max_width:
+                    self.full_launches += 1
+            else:
+                while epoch not in self._done and not self._closed:
+                    self._cv.wait(1.0)
+                if epoch not in self._done:
+                    raise RuntimeError("batcher closed mid-epoch")
+                results, error = self._done[epoch]
+                # last rider out sweeps the epoch's parking spot
+                self._rider_done(epoch)
+                if error is not None:
+                    raise error
+                return results[index]
+        # leader, outside the lock: run the launch for the whole epoch
+        results: Optional[List[Any]] = None
+        error: Optional[BaseException] = None
+        try:
+            results = self._launch(cargo)
+            if results is None or len(results) != len(cargo):
+                raise RuntimeError(
+                    f"launch returned {0 if results is None else len(results)}"
+                    f" results for {len(cargo)} items")
+        except BaseException as e:   # noqa: BLE001 — every rider must
+            # wake with THIS error, whatever class it is
+            error = e
+        with self._cv:
+            self.launches += 1
+            self._done[epoch] = (results, error)
+            self._riders[epoch] = len(cargo) - 1
+            if self._riders[epoch] == 0:
+                del self._done[epoch], self._riders[epoch]
+            self._cv.notify_all()
+        if error is not None:
+            raise error
+        return results[0]
+
+    def _rider_done(self, epoch: int) -> None:
+        # requires self._cv
+        self._riders[epoch] -= 1
+        if self._riders[epoch] == 0:
+            del self._done[epoch], self._riders[epoch]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {"launches": self.launches,
+                    "items_in": self.items_in,
+                    "full_launches": self.full_launches,
+                    "linger_waits": self.linger_waits,
+                    "linger_ms": round(self.linger_s * 1e3, 3),
+                    "max_width": self.max_width,
+                    "pending": len(self._items)}
 
 
 def stack_packed(batches: Sequence[PackedOps]) -> Dict[str, np.ndarray]:

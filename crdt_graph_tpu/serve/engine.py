@@ -664,6 +664,7 @@ class ServingEngine:
                  pipeline: Optional[bool] = None,
                  flight: Optional[flight_mod.FlightRecorder] = None,
                  fault: Optional[oracle_mod.FaultInjector] = None,
+                 mergetier=None,
                  start: bool = True):
         from .scheduler import MergeScheduler
         from .workers import MaintenanceWorker, WalSyncWorker
@@ -874,6 +875,29 @@ class ServingEngine:
             maint = self.maintenance
             self.shared_wal.set_compact_cb(
                 lambda: maint.enqueue("compact"))
+        # disaggregated merge tier (mergetier/; docs/MERGETIER.md):
+        # off unless a client (or worker list) is handed in or
+        # GRAFT_MERGETIER arms one from GRAFT_MERGETIER_WORKERS.
+        # GRAFT_MERGETIER=0 EXPLICITLY set is the A/B kill switch and
+        # overrides even an explicit client — every crdt_mergetier_*
+        # family then disappears and merges run the untouched local
+        # path.  Construction failure degrades to local-only serving.
+        from ..mergetier import client as mergetier_mod
+        self.mergetier: Optional[mergetier_mod.MergeTierClient] = None
+        if not mergetier_mod.tier_killed():
+            try:
+                if mergetier is not None:
+                    if isinstance(mergetier,
+                                  mergetier_mod.MergeTierClient):
+                        self.mergetier = mergetier
+                    else:
+                        self.mergetier = mergetier_mod.MergeTierClient(
+                            list(mergetier))
+                elif mergetier_mod.tier_enabled():
+                    self.mergetier = \
+                        mergetier_mod.MergeTierClient.from_env()
+            except (ValueError, OSError):
+                self.mergetier = None
         self.scheduler = MergeScheduler(self)
         # workers start before recovery: recovered docs arm their
         # spill policies against them at construction
@@ -1061,6 +1085,12 @@ class ServingEngine:
                 # a failed SAMPLE is not an audit failure: record the
                 # error without an "ok" verdict (no dump trigger)
                 audit = {"sample_error": repr(e)}
+        if audit is not None and isinstance(audit, dict):
+            # the chain audit's summary carries the round's achieved
+            # batched-launch width (local group size or the merge
+            # worker's cross-fleet width) — the shape evidence and the
+            # utilization evidence land in ONE sampled record
+            audit = {**audit, "batch_width": ct.batch_width}
         try:
             snap = doc.snapshot_view()
             self.flight.record({
@@ -1071,6 +1101,7 @@ class ServingEngine:
                 "applied_ops": ct.applied_ops,
                 "dup_ops": ct.dup_ops,
                 "coalesce_width": ct.n_tickets,
+                "batch_width": ct.batch_width,
                 "chunk_count": ct.chunk_count,
                 "queue_depth_admission": ct.queue_depth_admission,
                 "stages_ms": ct.stage_breakdown(),
@@ -1134,6 +1165,11 @@ class ServingEngine:
         # ops-axis sharded-merge routing (parallel/opsaxis.py)
         from ..parallel import opsaxis
         out["opsaxis"] = opsaxis.stats()
+        # disaggregated merge tier (mergetier/): None when off — the
+        # key's absence is the A/B contract the prom renderer and the
+        # loadgen report key off
+        out["mergetier"] = None if self.mergetier is None \
+            else self.mergetier.stats()
         return out
 
     def render_prom(self) -> str:
@@ -1178,6 +1214,8 @@ class ServingEngine:
             # named close (503 / event: closed) before the loops join
             self.reactor.stop(timeout=timeout)
         self.scheduler.shutdown(timeout=timeout)
+        if self.mergetier is not None:
+            self.mergetier.close()
         if self.sync_worker is not None:
             self.sync_worker.stop(timeout=timeout)
         if self.maintenance is not None:

@@ -883,6 +883,19 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # so invalid requests never materialize documents
             body = self._body(n)
             doc_id, sub, _ = self._route()
+            # merge-tier wire surface (docs/MERGETIER.md): a merge
+            # worker — any store exposing ``handle_merge`` — answers
+            # ``POST /merge`` with the packed-npz codec's bytes; the
+            # handler shape mirrors the fleet forward path so both
+            # transports serve identical responses
+            if doc_id is None and sub == "/merge" \
+                    and hasattr(store, "handle_merge"):
+                status, out_body, out_headers = store.handle_merge(body)
+                ctype = out_headers.pop("Content-Type",
+                                        "application/octet-stream")
+                self._send_raw(status, out_body, ctype=ctype,
+                               headers=out_headers)
+                return
             if doc_id is None or sub not in ("/replicas", "/ops"):
                 self._send(404, {"error": "not found"})
                 return

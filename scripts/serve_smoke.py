@@ -595,6 +595,126 @@ def run_fleet_procs(n_procs: int = 3, gens: int = 4) -> dict:
                 o["stats"]["shared_bytes"] for o in outs)}
 
 
+def run_mergetier(n_docs: int = 3, n_ops: int = 1200) -> dict:
+    """Merge-tier wire-contract smoke (docs/MERGETIER.md; wired into
+    tier-1 via tests/test_serve_smoke.py::test_mergetier_smoke):
+
+    one merge worker behind a REAL ``POST /merge`` HTTP surface, one
+    front-end engine armed with the tier, one local-only control.
+    ``n_docs`` coalescible deltas land through the front-end's real
+    ``/docs/{id}/ops`` surface in one staged round, so the round ships
+    to the worker, coalesces in its linger window, and comes back as
+    ONE batched launch — then every document's values, clock, and
+    replica-independent state fingerprint must equal the control's,
+    the client must report zero fallbacks, and BOTH prom scrapes
+    (front-end ``crdt_mergetier_*``, worker
+    ``crdt_mergetier_worker_*`` with the linger occupancy gauge) must
+    strict-parse over HTTP.  Clean shutdown on every piece."""
+    from crdt_graph_tpu.cluster.pool import ConnectionPool
+    from crdt_graph_tpu.codec import json_codec
+    from crdt_graph_tpu.core.operation import Add, Batch
+    from crdt_graph_tpu.mergetier.client import MergeTierClient
+    from crdt_graph_tpu.mergetier.worker import MergeWorkerServer
+    from crdt_graph_tpu.obs import prom as prom_mod
+    from crdt_graph_tpu.serve import ServingEngine
+    from crdt_graph_tpu.service import make_server
+
+    def chain_body(rid, n):
+        ops, prev = [], 0
+        for i in range(n):
+            ts = rid * 2**32 + i + 1
+            ops.append(Add(ts, (prev,), f"{rid}:{i}"))
+            prev = ts
+        return json_codec.dumps(Batch(tuple(ops)))
+
+    from crdt_graph_tpu.mergetier.worker import MergeWorker
+    # a deliberately wide linger window: the smoke asserts the EXACT
+    # coalesced width, so encode/HTTP skew between the three requests
+    # must not split the epoch (production tunes GRAFT_MERGETIER_BATCH_MS
+    # against fleet arrival rates instead)
+    worker_srv = MergeWorkerServer(MergeWorker(linger_ms=150.0))
+    engine = ServingEngine(start=False, cross_doc=True,
+                           mergetier=MergeTierClient([worker_srv.addr],
+                                                     src="smoke-fe"))
+    assert engine.mergetier is not None, "tier did not arm"
+    srv = make_server(port=0, store=engine)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_port
+    control = ServingEngine(start=True, cross_doc=True)
+    pool = ConnectionPool()
+    doc_ids = [f"mt{i}" for i in range(n_docs)]
+    bodies = {d: chain_body(i + 2, n_ops)
+              for i, d in enumerate(doc_ids)}
+    results = {}
+
+    def post(doc_id):
+        resp, raw = pool.request(
+            "smoke-mt", "server", "127.0.0.1", port, "POST",
+            f"/docs/{doc_id}/ops", body=bodies[doc_id],
+            headers={"Content-Type": "application/json"}, timeout=180)
+        results[doc_id] = (resp.status, json.loads(raw))
+
+    threads = [threading.Thread(target=post, args=(d,), daemon=True)
+               for d in doc_ids]
+    for t in threads:
+        t.start()
+    # every delta staged before the ONE scheduling round: the round is
+    # what the tier coalesces, so arrival skew must not split it
+    deadline = time.monotonic() + 30
+    for d in doc_ids:
+        while len(engine.get(d).queue) < 1:
+            assert time.monotonic() < deadline, "staging stalled"
+            time.sleep(0.002)
+    assert engine.scheduler.step() == n_docs
+    for t in threads:
+        t.join(120)
+    for d, (st, out) in results.items():
+        assert st == 200, f"{d}: POST /ops answered {st}"
+        assert out["applied_count"] == n_ops, f"{d}: {out}"
+        control.submit(d, bodies[d])
+
+    # remote-vs-local convergence at the wire: values, clock, and the
+    # replica-independent fingerprint all match the local-only control
+    for d in doc_ids:
+        sv, cv = engine.get(d).snapshot_view(), \
+            control.get(d).snapshot_view()
+        assert engine.get(d).snapshot() == control.get(d).snapshot(), d
+        assert engine.get(d).clock() == control.get(d).clock(), d
+        assert sv.state_fingerprint() == cv.state_fingerprint(), d
+    mst = engine.mergetier.stats()
+    assert mst["remote_docs"] == n_docs, mst
+    assert not mst["fallbacks"], mst
+    wst = worker_srv.worker.stats()
+    assert wst["batch_width"]["max"] == n_docs, wst
+
+    # both prom surfaces strict-parse over HTTP, tier families present
+    resp, raw = pool.request("smoke-mt", "server", "127.0.0.1", port,
+                             "GET", "/metrics/prom", timeout=60)
+    assert resp.status == 200
+    fams = prom_mod.parse_text(raw.decode())
+    assert "crdt_mergetier_rounds_total" in fams
+    assert "crdt_mergetier_batch_width" in fams
+    resp, raw = pool.request("smoke-mt", "worker", "127.0.0.1",
+                             worker_srv.port, "GET", "/metrics/prom",
+                             timeout=60)
+    assert resp.status == 200
+    wfams = prom_mod.parse_text(raw.decode())
+    assert "crdt_mergetier_worker_launches_total" in wfams
+    assert "crdt_mergetier_worker_linger_occupancy" in wfams
+
+    pool.close()
+    srv.shutdown()
+    srv.server_close()
+    engine.close()
+    control.close()
+    worker_srv.stop()
+    return {"harness": "serve_smoke_mergetier", "docs": n_docs,
+            "ops_per_doc": n_ops, "remote_docs": mst["remote_docs"],
+            "batch_width_max": wst["batch_width"]["max"],
+            "launches": wst["batcher"]["launches"],
+            "fallbacks": mst["fallbacks"]}
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
     if "--fleet-proc-worker" in argv:
@@ -608,6 +728,8 @@ if __name__ == "__main__":
         i = argv.index("--fleet")
         n = int(argv[i + 1]) if len(argv) > i + 1 else 3
         out = run_fleet(n_servers=n)
+    elif "--mergetier" in argv:
+        out = run_mergetier()
     else:
         out = run(*(int(a) for a in argv[:3]))
     print(json.dumps(out), flush=True)

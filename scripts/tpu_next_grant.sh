@@ -95,5 +95,17 @@ for name, body in (
                   "out": out.tolist()}
 print(json.dumps({"probe": "opsaxis_ring_carry", "devices": k, **legs}))
 PYEOF
+  # === disaggregated merge tier (docs/MERGETIER.md §Headline) ===
+  # the on-chip twin of BENCH_MERGETIER_r01_cpu.json: three front-ends
+  # share ONE pooled worker vs one private worker each vs tier-off.
+  # The number that changes on real hardware is the batched launch
+  # itself — whether width-12 cross-fleet epochs amortize launch
+  # overhead the way the CPU interleave says they do, and what the
+  # remote_merge ack stage costs when the launch is no longer the wall
+  echo "=== mergetier coalescing on-chip A/B $(date -u +%H:%M:%S) ==="
+  timeout 1800 env JAX_PLATFORMS=tpu \
+    python scripts/bench_mergetier_headline.py \
+    "$OUT/BENCH_MERGETIER_r01_tpu.json" \
+    >> "$OUT/tpu_mergetier.jsonl" 2>> "$OUT/tpu_mergetier.err"
   echo "=== done $(date -u +%H:%M:%S) ==="
 } >> "$OUT/tpu_next_grant.log" 2>&1

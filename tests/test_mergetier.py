@@ -1,0 +1,665 @@
+"""Disaggregated merge tier (mergetier/; docs/MERGETIER.md): the wire
+codec's digests, the linger batcher's epochs, and — the acceptance pin
+— bit-identity between the local merge path and the remote worker path
+over the in-process transport: equal state fingerprints, byte-identical
+``/ops`` windows, identical ``last_applied_mask`` attribution, dup
+re-sends included.
+
+The failure half: worker death mid-round and a netchaos cut on the
+merge link both fall back to the local merge with zero acked loss (the
+dedicated ``mid-remote-merge`` crash leg recovers a durable front-end
+that died with verified frames in hand and nothing committed);
+``GRAFT_MERGETIER=0`` is the A/B kill switch that leaves the engine —
+and its scrape — byte-identical to a local-only build.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.codec import json_codec                 # noqa: E402
+from crdt_graph_tpu.codec import packed as packed_mod       # noqa: E402
+from crdt_graph_tpu.core.operation import Add, Batch        # noqa: E402
+from crdt_graph_tpu.mergetier import client as client_mod   # noqa: E402
+from crdt_graph_tpu.mergetier import wire                   # noqa: E402
+from crdt_graph_tpu.mergetier import MergeWorker            # noqa: E402
+from crdt_graph_tpu.mergetier.worker import MergeWorkerServer  # noqa: E402
+from crdt_graph_tpu.obs import flight as flight_mod         # noqa: E402
+from crdt_graph_tpu.obs import prom as prom_mod             # noqa: E402
+from crdt_graph_tpu.parallel import mesh as mesh_mod        # noqa: E402
+from crdt_graph_tpu.serve import ServingEngine, SchedulerStopped  # noqa: E402
+
+OFFSET = 2**32
+N = 1200   # above the kernel crossover, coalescible in one chunk
+
+
+def chain_ops(rid, n, counter0=0, anchor=0):
+    ops, prev = [], anchor
+    for i in range(n):
+        ts = rid * OFFSET + counter0 + i + 1
+        ops.append(Add(ts, (prev,), (counter0 + i) & 0xFF))
+        prev = ts
+    return ops
+
+
+def submit_async(engine, doc_id, body):
+    box = {}
+
+    def go():
+        try:
+            box["result"] = engine.submit(doc_id, body)
+        except BaseException as e:          # noqa: BLE001 — test capture
+            box["error"] = e
+
+    th = threading.Thread(target=go, daemon=True)
+    th.start()
+    return th, box
+
+
+def wait_queue_depth(engine, doc_id, depth, timeout=10.0):
+    doc = engine.get(doc_id)
+    deadline = time.monotonic() + timeout
+    while len(doc.queue) < depth:
+        assert time.monotonic() < deadline, \
+            f"queue never reached depth {depth} (at {len(doc.queue)})"
+        time.sleep(0.002)
+
+
+def _push_staged(engine, doc_bodies):
+    """Stage one delta per doc with the scheduler stopped, run one
+    scheduling round synchronously, resolve all."""
+    pairs = []
+    for doc_id, body in doc_bodies:
+        engine.get(doc_id)
+        pairs.append(submit_async(engine, doc_id, body))
+    for doc_id, _ in doc_bodies:
+        wait_queue_depth(engine, doc_id, 1)
+    assert engine.scheduler.step() == len(doc_bodies)
+    for th, box in pairs:
+        th.join(30)
+        assert box["result"][0], "staged push rejected"
+
+
+# -- wire codec ------------------------------------------------------------
+
+
+def test_wire_request_roundtrip_and_tamper():
+    """encode_request → decode_request is lossless (capacity restored,
+    meta intact, digest bound); truncation and bit-flips are detected,
+    never mis-decoded."""
+    p = packed_mod.pack(chain_ops(1, 64))
+    body = wire.encode_request("docA", p, 64)
+    p2, meta = wire.decode_request(body)
+    assert meta["doc_id"] == "docA" and meta["num_new"] == 64
+    assert meta["num_ops"] == p.num_ops
+    assert p2.num_ops == p.num_ops and p2.capacity == p.capacity
+    assert p2.values == p.values
+    a1, a2 = p.arrays(), p2.arrays()
+    assert set(a1) == set(a2)
+    for k in a1:
+        assert np.array_equal(np.asarray(a1[k]), np.asarray(a2[k])), k
+    # truncated body
+    with pytest.raises(wire.MergeWireError):
+        wire.decode_request(body[:len(body) // 2])
+    # bit-flip mid-payload: either the container or the digest trips
+    flipped = bytearray(body)
+    flipped[(6 * len(body)) // 10] ^= 0x40
+    with pytest.raises(wire.MergeWireError):
+        wire.decode_request(bytes(flipped))
+    # num_new outside the row count is rejected even when well-formed
+    with pytest.raises(wire.MergeWireError):
+        wire.decode_request(wire.encode_request("docA", p, p.num_ops + 1))
+
+
+def test_wire_response_roundtrip_and_tamper():
+    """A real worker answer decodes (frame digest recomputed, digest
+    echoed); a corrupted or truncated frame raises, and a corrupt
+    REQUEST answers 400 without touching the batcher."""
+    w = MergeWorker(linger_ms=0.0, name="wire-w")
+    try:
+        p = packed_mod.pack(chain_ops(1, 64))
+        req = wire.encode_request("docA", p, 64)
+        status, resp, headers = w.handle_merge(req)
+        assert status == 200
+        assert headers["Content-Type"] == "application/octet-stream"
+        table, meta = wire.decode_response(resp)
+        assert meta["input_digest"] == wire.request_digest(p)
+        assert meta["width"] == 1
+        assert int(table.ts.shape[0]) == meta["shared_capacity"] + 2
+        assert 0 < int(table.num_nodes) <= int(table.ts.shape[0])
+        # tampered / truncated responses must not decode
+        with pytest.raises(wire.MergeWireError):
+            wire.decode_response(resp[:len(resp) - 40])
+        flipped = bytearray(resp)
+        flipped[(6 * len(resp)) // 10] ^= 0x10
+        with pytest.raises(wire.MergeWireError):
+            wire.decode_response(bytes(flipped))
+        # a corrupt request is a 400 + wire_errors, nothing merged
+        status, _, _ = w.handle_merge(req[:32])
+        assert status == 400
+        st = w.stats()
+        assert st["wire_errors"] == 1 and st["merged_docs"] == 1
+    finally:
+        w.close()
+
+
+# -- the linger batcher ----------------------------------------------------
+
+
+def test_linger_batcher_epochs_widths_and_close():
+    """Concurrent submitters meet in one epoch (each gets exactly its
+    own result), the width cap launches early, a failed launch fails
+    every rider with the same error, and close() severs submitters."""
+    launched = []
+
+    def launch(items):
+        launched.append(list(items))
+        if "boom" in items:
+            raise ValueError("epoch failed")
+        return [x * 10 for x in items]
+
+    b = mesh_mod.LingerBatcher(launch, linger_s=0.2, max_width=4)
+    results, errs = {}, {}
+
+    def run(i):
+        try:
+            results[i] = b.submit(i)
+        except Exception as e:       # noqa: BLE001 — test capture
+            errs[i] = e
+
+    ths = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    assert results == {0: 0, 1: 10, 2: 20}
+    assert len(launched) == 1 and sorted(launched[0]) == [0, 1, 2]
+    st = b.stats()
+    assert st["launches"] == 1 and st["items_in"] == 3
+    assert st["linger_waits"] == 1 and st["full_launches"] == 0
+    # width cap: 4 submitters launch immediately, no linger
+    ths = [threading.Thread(target=run, args=(i,)) for i in range(10, 14)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    assert all(results[i] == i * 10 for i in range(10, 14))
+    assert b.stats()["full_launches"] == 1
+    # a failed epoch fails EVERY rider with the launch's error
+    ths = [threading.Thread(target=run, args=(x,))
+           for x in ("boom", "rider")]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    assert isinstance(errs["boom"], ValueError)
+    assert isinstance(errs["rider"], ValueError)
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.submit(99)
+
+
+# -- the acceptance pin: remote ≡ local ------------------------------------
+
+
+def _assert_docs_equiv(remote, local, doc_ids, mids=()):
+    for d in doc_ids:
+        rd, ld = remote.get(d), local.get(d)
+        assert rd.snapshot() == ld.snapshot(), d
+        assert rd.clock() == ld.clock(), d
+        assert rd.read_view().state_fingerprint() == \
+            ld.read_view().state_fingerprint(), d
+        # byte-identical /ops windows at every tier seam
+        assert rd.dumps_since_bytes(0) == ld.dumps_since_bytes(0), d
+        for since in mids:
+            assert rd.dumps_since_bytes(since) == \
+                ld.dumps_since_bytes(since), (d, since)
+        # identical per-row attribution of the LAST commit
+        m1, m2 = rd.tree.last_applied_mask, ld.tree.last_applied_mask
+        assert m1 is not None and m2 is not None, d
+        assert np.array_equal(np.asarray(m1), np.asarray(m2)), d
+
+
+def test_remote_local_bit_identity_inproc():
+    """The tier-1 equivalence pin: the same op stream through a
+    remote-merge engine (in-process transport, one pooled worker) and
+    a local-only engine — fresh coalesced waves, a second wave on the
+    committed state, a half-duplicate re-send, and a giant single that
+    takes the min-ops route — is bit-identical at every seam."""
+    worker = MergeWorker(linger_ms=200.0, name="pin-w")
+    mt = client_mod.MergeTierClient([worker], src="pin-fe")
+    remote = ServingEngine(start=False, cross_doc=True, mergetier=mt,
+                           flight=flight_mod.FlightRecorder())
+    local = ServingEngine(start=False, cross_doc=True)
+    docs = [f"x{i}" for i in range(3)]
+
+    def bodies(counter0, anchor_c):
+        return [(f"x{i}", json_codec.dumps(Batch(tuple(chain_ops(
+            i + 2, N, counter0=counter0,
+            anchor=((i + 2) * OFFSET + anchor_c) if anchor_c else 0)))))
+            for i in range(3)]
+
+    try:
+        # wave 1: fresh 3-doc coalesced round → one remote width-3 epoch
+        _push_staged(remote, bodies(0, 0))
+        _push_staged(local, bodies(0, 0))
+        _assert_docs_equiv(remote, local, docs)
+        mst = mt.stats()
+        assert mst["remote_docs"] == 3 and not mst["fallbacks"]
+        assert mst["width"]["max"] == 3
+        assert worker.stats()["batcher"]["launches"] == 1
+        # wave 2 lands on the REMOTE-committed state (n0 > 0)
+        _push_staged(remote, bodies(N, N))
+        _push_staged(local, bodies(N, N))
+        mids = [4 * OFFSET + N // 2]   # a mid-chain window seam
+        _assert_docs_equiv(remote, local, docs, mids=mids)
+        # wave 3: half-duplicate re-send — attribution must mark the
+        # same rows dup/applied on both paths
+        _push_staged(remote, bodies(N + N // 2, N + N // 2))
+        _push_staged(local, bodies(N + N // 2, N + N // 2))
+        _assert_docs_equiv(remote, local, docs, mids=mids)
+        mask = np.asarray(remote.get("x0").tree.last_applied_mask)
+        assert mask.sum() == N // 2    # second half fresh, first dup
+        assert mt.stats()["remote_docs"] == 9
+        # the giant single route: >= GRAFT_MERGETIER_MIN_OPS (default
+        # 4096) fused ops ship remote even without co-travellers
+        giant = [("x0", json_codec.dumps(Batch(tuple(chain_ops(
+            2, 4200, counter0=2 * N + N // 2,
+            anchor=2 * OFFSET + 2 * N + N // 2)))))]
+        _push_staged(remote, giant)
+        _push_staged(local, giant)
+        _assert_docs_equiv(remote, local, ["x0"], mids=mids)
+        mst = mt.stats()
+        assert mst["remote_docs"] == 10 and not mst["fallbacks"]
+        assert remote.counters.get("mergetier_fallbacks") == 0
+        # the flight/chainaudit surface carries the achieved width
+        widths = [r.batch_width for r in remote.flight.records()
+                  if r.outcome == "committed"]
+        assert 3 in widths and 1 in widths
+        assert remote.scheduler_metrics()["mergetier"] is not None
+    finally:
+        remote.close()
+        local.close()
+        worker.close()
+
+
+def test_remote_over_http_single_roundtrip():
+    """One giant write through a REAL worker server (HTTP transport):
+    the verified frame commits and matches the local engine."""
+    srv = MergeWorkerServer(MergeWorker(linger_ms=1.0, name="http-w"))
+    mt = client_mod.MergeTierClient([srv.addr], src="http-fe")
+    remote = ServingEngine(start=False, mergetier=mt)
+    local = ServingEngine(start=False)
+    try:
+        body = json_codec.dumps(Batch(tuple(chain_ops(3, 4200))))
+        _push_staged(remote, [("h0", body)])
+        _push_staged(local, [("h0", body)])
+        _assert_docs_equiv(remote, local, ["h0"])
+        mst = mt.stats()
+        assert mst["remote_docs"] == 1 and not mst["fallbacks"]
+        assert mst["workers"][0]["inproc"] is False
+        assert srv.worker.stats()["merged_docs"] == 1
+    finally:
+        remote.close()
+        local.close()
+        srv.stop()
+
+
+# -- the fallback ladder ---------------------------------------------------
+
+
+def test_dead_worker_falls_back_local_zero_loss():
+    """Every request to a dead worker falls back to the bit-identical
+    local merge: all writes ack, documents match a local-only engine,
+    and the ladder counts the rung."""
+    worker = MergeWorker(linger_ms=1.0, name="dead-w")
+    worker.crash()                    # answers 503 from the first byte
+    mt = client_mod.MergeTierClient([worker], src="dead-fe")
+    remote = ServingEngine(start=False, cross_doc=True, mergetier=mt)
+    local = ServingEngine(start=False, cross_doc=True)
+    docs = [f"d{i}" for i in range(3)]
+    bodies = [(f"d{i}", json_codec.dumps(Batch(tuple(
+        chain_ops(i + 2, N))))) for i in range(3)]
+    try:
+        _push_staged(remote, bodies)
+        _push_staged(local, bodies)
+        _assert_docs_equiv(remote, local, docs)
+        mst = mt.stats()
+        assert mst["fallbacks"] == {"http_status": 3}
+        assert mst["remote_docs"] == 0
+        assert remote.counters.get("mergetier_fallbacks") == 3
+    finally:
+        remote.close()
+        local.close()
+
+
+def test_digest_mismatch_falls_back(monkeypatch):
+    """A well-formed frame bound to a DIFFERENT request (echoed
+    input_digest mismatch) must never commit — counted fallback, local
+    merge instead."""
+    worker = MergeWorker(linger_ms=1.0, name="digest-w")
+    real = worker.handle_merge
+
+    def forged(body):
+        status, resp, headers = real(body)
+        if status != 200:
+            return status, resp, headers
+        table, meta = wire.decode_response(resp)
+        return 200, wire.encode_response(
+            table, meta["shared_capacity"], meta["width"],
+            "0badc0ffee0badc0"), headers
+
+    monkeypatch.setattr(worker, "handle_merge", forged)
+    mt = client_mod.MergeTierClient([worker], src="digest-fe")
+    remote = ServingEngine(start=False, mergetier=mt)
+    local = ServingEngine(start=False)
+    try:
+        body = json_codec.dumps(Batch(tuple(chain_ops(5, 4200))))
+        _push_staged(remote, [("g0", body)])
+        _push_staged(local, [("g0", body)])
+        _assert_docs_equiv(remote, local, ["g0"])
+        assert mt.stats()["fallbacks"] == {"digest": 1}
+    finally:
+        remote.close()
+        local.close()
+        worker.close()
+
+
+def test_breaker_opens_and_probes_recovered_worker():
+    """Repeated failures open the worker's breaker (later rounds skip
+    it outright, one cooldown probe excepted) and a recovered worker
+    closes it again through the probe."""
+    worker = MergeWorker(linger_ms=1.0, name="flaky-w")
+    mt = client_mod.MergeTierClient(
+        [worker], src="brk-fe", breaker_threshold=2,
+        breaker_cooldown_s=0.05)
+    p = packed_mod.pack(chain_ops(1, 2048))
+    worker._dead = True                   # fail without closing batcher
+    for _ in range(2):
+        with pytest.raises(client_mod.MergeFallback):
+            mt.merge_one("b0", p, p.num_ops)
+    ws = mt.stats()["workers"][0]
+    assert ws["breaker_open"] and ws["breaker_opens"] == 1
+    # breaker open + cooldown not elapsed → no request reaches the worker
+    with pytest.raises(client_mod.MergeFallback) as ei:
+        mt.merge_one("b0", p, p.num_ops)
+    assert ei.value.reason == "breaker_open"
+    # after the cooldown the probe goes through; a healthy worker
+    # closes the breaker with one success
+    worker._dead = False
+    time.sleep(0.06)
+    table, shared, width = mt.merge_one("b0", p, p.num_ops)
+    assert width == 1 and shared >= p.capacity
+    ws = mt.stats()["workers"][0]
+    assert not ws["breaker_open"] and ws["ok"] == 1
+    worker.close()
+    mt.close()
+
+
+def test_kill_switch_and_env_arming(monkeypatch):
+    """GRAFT_MERGETIER=0 disarms the tier even over an explicit worker
+    list; GRAFT_MERGETIER=1 arms from GRAFT_MERGETIER_WORKERS but
+    degrades to local-only when no worker is named."""
+    worker = MergeWorker(linger_ms=1.0, name="kill-w")
+    monkeypatch.setenv("GRAFT_MERGETIER", "0")
+    eng = ServingEngine(start=False, mergetier=[worker])
+    try:
+        assert eng.mergetier is None
+        assert eng.scheduler_metrics()["mergetier"] is None
+    finally:
+        eng.close()
+    # armed-but-empty env: stays local rather than arming a client
+    # that can only fall back
+    monkeypatch.setenv("GRAFT_MERGETIER", "1")
+    monkeypatch.delenv("GRAFT_MERGETIER_WORKERS", raising=False)
+    eng = ServingEngine(start=False)
+    try:
+        assert eng.mergetier is None
+    finally:
+        eng.close()
+    # env-named workers arm the client
+    monkeypatch.setenv("GRAFT_MERGETIER_WORKERS", "127.0.0.1:9,127.0.0.1:10")
+    eng = ServingEngine(start=False)
+    try:
+        assert eng.mergetier is not None
+        assert len(eng.mergetier.workers) == 2
+    finally:
+        eng.close()
+    worker.close()
+
+
+# -- worker death mid-round (crash site mid-remote-merge) ------------------
+
+
+def test_crash_mid_remote_merge_zero_acked_loss(tmp_path, monkeypatch):
+    """A durable front-end dies at ``mid-remote-merge`` — verified
+    frames in hand, nothing committed, nothing acked: recovery serves
+    every previously acked write, the doomed delta is simply absent
+    (never acked), and the recovered doc accepts writes at once."""
+    monkeypatch.setenv("GRAFT_MERGETIER_MIN_OPS", "1024")
+    worker = MergeWorker(linger_ms=1.0, name="crash-w")
+    ddir = tmp_path / "dur"
+    eng = ServingEngine(
+        durable_dir=str(ddir), wal_sync="batch", submit_timeout_s=2.0,
+        flight=flight_mod.FlightRecorder(),
+        mergetier=client_mod.MergeTierClient([worker], src="crash-fe"))
+    acked = []
+    ops = chain_ops(1, 15)
+    for i in range(0, 15, 5):
+        ok, _ = eng.submit("doc", json_codec.dumps(
+            Batch(tuple(ops[i:i + 5]))))
+        assert ok
+        acked.extend(ops[i:i + 5])
+    assert eng.flush(30)
+    monkeypatch.setenv("GRAFT_CRASH_POINT", "mid-remote-merge")
+    doomed_ops = chain_ops(1, 1100, counter0=15, anchor=OFFSET + 15)
+    crashed = {}
+
+    def doomed():
+        try:
+            crashed["ack"] = eng.submit("doc", json_codec.dumps(
+                Batch(tuple(doomed_ops))))
+        except SchedulerStopped:
+            crashed["ack"] = None
+
+    th = threading.Thread(target=doomed, daemon=True)
+    th.start()
+    eng.scheduler.join(30)
+    assert not eng.scheduler.is_alive(), "mid-remote-merge never fired"
+    th.join(10)
+    # the site sits between the worker's answer and the commit: the
+    # remote merge HAPPENED, the ack never did
+    assert crashed.get("ack") is None, "a write acked after the crash"
+    assert worker.stats()["merged_docs"] == 1
+    monkeypatch.delenv("GRAFT_CRASH_POINT")
+    worker.close()
+    # recover from disk (the wounded engine is abandoned, un-closed)
+    eng2 = ServingEngine(durable_dir=str(ddir), wal_sync="batch")
+    try:
+        doc2 = eng2.get("doc", create=False)
+        assert doc2 is not None and doc2.epoch == 2
+        assert doc2.snapshot() == [op.value for op in acked]
+        # serving-ready: an independent chain lands immediately
+        ok, _ = eng2.submit("doc", json_codec.dumps(
+            Batch(tuple(chain_ops(9, 3)))))
+        assert ok
+    finally:
+        eng2.close()
+
+
+def test_netchaos_cut_on_merge_link_falls_back(monkeypatch):
+    """A deterministic netchaos cut on the front-end→worker link
+    severs every remote merge mid-response: the production ladder
+    falls back locally, every write acks, zero loss — and the fired
+    counters prove the faults actually hit the merge path."""
+    from crdt_graph_tpu.cluster import netchaos as netchaos_mod
+    srv = MergeWorkerServer(MergeWorker(linger_ms=200.0, name="cut-w"))
+    chaos = netchaos_mod.NetChaos(seed=7, spec="cut=1.0")
+    mt = client_mod.MergeTierClient([srv.addr], src="cut-fe",
+                                    chaos=chaos)
+    remote = ServingEngine(start=False, cross_doc=True, mergetier=mt)
+    local = ServingEngine(start=False, cross_doc=True)
+    docs = [f"c{i}" for i in range(3)]
+    bodies = [(f"c{i}", json_codec.dumps(Batch(tuple(
+        chain_ops(i + 2, N))))) for i in range(3)]
+    try:
+        _push_staged(remote, bodies)
+        _push_staged(local, bodies)
+        _assert_docs_equiv(remote, local, docs)
+        mst = mt.stats()
+        assert mst["remote_docs"] == 0
+        assert sum(mst["fallbacks"].values()) == 3
+        assert set(mst["fallbacks"]) <= {"transport", "breaker_open",
+                                         "timeout"}
+        assert chaos.counters["cuts"] >= 1
+        assert remote.counters.get("mergetier_fallbacks") == 3
+    finally:
+        remote.close()
+        local.close()
+        srv.stop()
+
+
+# -- telemetry: present when on, ABSENT when off ---------------------------
+
+
+def test_prom_families_present_when_armed_absent_when_off():
+    worker = MergeWorker(linger_ms=200.0, name="prom-w")
+    mt = client_mod.MergeTierClient([worker], src="prom-fe")
+    on = ServingEngine(start=False, cross_doc=True, mergetier=mt)
+    off = ServingEngine(start=False, cross_doc=True)
+    try:
+        bodies = [(f"p{i}", json_codec.dumps(Batch(tuple(
+            chain_ops(i + 2, N))))) for i in range(3)]
+        _push_staged(on, bodies)
+        fams = prom_mod.parse_text(on.render_prom())   # strict parse
+        for fam in ("crdt_mergetier_workers",
+                    "crdt_mergetier_workers_open",
+                    "crdt_mergetier_breaker_opens_total",
+                    "crdt_mergetier_rounds_total",
+                    "crdt_mergetier_remote_docs_total",
+                    "crdt_mergetier_remote_ops_total",
+                    "crdt_mergetier_fallbacks_total",
+                    "crdt_mergetier_batch_width",
+                    "crdt_mergetier_remote_ms"):
+            assert fam in fams, fam
+        assert fams["crdt_mergetier_remote_docs_total"][
+            "samples"][0][2] == 3.0
+        assert fams["crdt_mergetier_workers"]["samples"][0][2] == 1.0
+        # the worker-side scrape (its own /metrics/prom) parses too,
+        # linger occupancy and width distribution included
+        wfams = prom_mod.parse_text(worker.render_prom())
+        for fam in ("crdt_mergetier_worker_up",
+                    "crdt_mergetier_worker_requests_total",
+                    "crdt_mergetier_worker_launches_total",
+                    "crdt_mergetier_worker_linger_occupancy",
+                    "crdt_mergetier_worker_batch_width"):
+            assert fam in wfams, fam
+        assert wfams["crdt_mergetier_worker_up"]["samples"][0][2] == 1.0
+        # tier off: every crdt_mergetier_* family is ABSENT (the A/B
+        # scrape contract)
+        off_fams = prom_mod.parse_text(off.render_prom())
+        assert not [f for f in off_fams
+                    if f.startswith("crdt_mergetier_")]
+    finally:
+        on.close()
+        off.close()
+        worker.close()
+
+
+# -- worker pool registration over the coordination KV ---------------------
+
+
+def test_mergepool_register_expire_and_keeper():
+    from crdt_graph_tpu.cluster import mergepool
+    from crdt_graph_tpu.cluster.kv import MemoryKV
+    kv = MemoryKV()
+    now = [1000.0]
+    clock = lambda: now[0]                         # noqa: E731
+    mergepool.register(kv, "w1", "127.0.0.1:9101", ttl_s=5.0,
+                       clock=clock)
+    mergepool.register(kv, "w0", "127.0.0.1:9100", ttl_s=5.0,
+                       clock=clock)
+    workers = mergepool.list_workers(kv, clock=clock)
+    assert [w["name"] for w in workers] == ["w0", "w1"]   # name-sorted
+    # re-registration refreshes (CAS over the old incarnation)
+    mergepool.register(kv, "w1", "127.0.0.1:9201", ttl_s=5.0,
+                       clock=clock)
+    workers = mergepool.list_workers(kv, clock=clock)
+    assert workers[1]["addr"] == "127.0.0.1:9201"
+    # a worker that stops renewing ages out at its TTL
+    now[0] += 6.0
+    assert mergepool.list_workers(kv, clock=clock) == []
+    # the keeper renews under real time; stop deregisters
+    keeper = mergepool.MergePoolKeeper(kv, "w2", "127.0.0.1:9102",
+                                       ttl_s=5.0).start()
+    assert [w["name"] for w in mergepool.list_workers(kv)] == ["w2"]
+    keeper.stop()
+    assert mergepool.list_workers(kv) == []
+    # from_env(kv=...) builds the client off the registry
+    mergepool.register(kv, "w3", "127.0.0.1:9103", ttl_s=60.0)
+    mt = client_mod.MergeTierClient.from_env(src="kv-fe", kv=kv)
+    assert mt is not None
+    assert mt.workers[0].endpoint == "127.0.0.1:9103"
+    mt.close()
+
+
+# -- the closed-loop oracle leg --------------------------------------------
+
+
+def test_loadgen_with_mergetier_zero_violations(monkeypatch):
+    """A full closed-loop loadgen run with the tier armed: zero oracle
+    violations, the giant racer routed remote, the report carries the
+    mergetier block and the remote_merge ack stage."""
+    from crdt_graph_tpu.bench import loadgen
+    monkeypatch.setenv("GRAFT_MERGETIER_MIN_OPS", "1024")
+    worker = MergeWorker(linger_ms=1.0, name="load-w")
+    engine = ServingEngine(
+        flight=flight_mod.FlightRecorder(capacity=4096),
+        max_queue_requests=64,
+        mergetier=client_mod.MergeTierClient([worker], src="load-fe"))
+    cfg = loadgen.LoadgenConfig(
+        n_sessions=8, n_docs=2, writes_per_session=4, delta_size=8,
+        max_queue_requests=64, giant_ops=2000, stage_first_round=True,
+        seed=3)
+    try:
+        rep = loadgen.run(cfg, engine=engine)
+    finally:
+        engine.close()
+        worker.close()
+    assert not rep["errors"], rep["errors"]
+    assert rep["oracle"]["violations_total"] == 0
+    assert rep["violations"] == []
+    assert rep["writes_acked"] == 8 * 4 + 1          # + the giant
+    mst = rep["mergetier"]
+    assert mst is not None and mst["remote_docs"] >= 1
+    assert not mst["fallbacks"]
+    assert rep["ack_breakdown_ms"]["remote_merge"] is not None
+
+
+@pytest.mark.slow
+def test_bench_mergetier_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_MERGETIER_r01_cpu.json
+    shape): interleaved coalesced / per-replica / local legs, mean
+    cross-fleet width ≥ 2× the per-replica baseline, zero fallbacks on
+    the tiered legs, zero violations everywhere.  Slow-marked — the
+    tier-1 gate runs the loadgen smoke above instead."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "_bench_mergetier_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_mergetier_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_MERGETIER_test.json"))
+    assert out["gate"]["pass"]
+    assert out["violations_total"] == 0 and out["errors_total"] == 0
+    assert out["legs"]["coalesced"]["best"]["mean_width"] >= \
+        2 * out["legs"]["perreplica"]["best"]["mean_width"]
+    assert out["legs"]["local"]["best"]["writes_per_sec"] > 0

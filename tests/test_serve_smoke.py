@@ -42,6 +42,18 @@ def test_fleet_procs_shm_exact_ledger():
     assert summary["shared_bytes"] > 0
 
 
+def test_mergetier_smoke_end_to_end():
+    """--mergetier mode (disaggregated merge tier): 3 docs through a
+    REAL worker server over HTTP coalesce into ONE width-3 launch,
+    zero fallbacks, bit-identical to a local-only control engine, and
+    both scrape surfaces (front-end + worker) strict-parse."""
+    summary = _serve_smoke.run_mergetier(n_docs=3, n_ops=1200)
+    assert summary["remote_docs"] == 3
+    assert summary["batch_width_max"] == 3
+    assert summary["launches"] == 1
+    assert summary["fallbacks"] == {}
+
+
 def test_serve_smoke_end_to_end():
     summary = _serve_smoke.run(n_docs=4, writers_per_doc=3, deltas=3,
                                delta_size=8)
