@@ -1664,6 +1664,15 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
         + (cfg.giant_ops if cfg.giant_ops and "acked_s" in giant_state
            else 0)
     ost = h.oracle.stats()
+    # write-to-visibility ledger + canary (ISSUE 20): each member's
+    # per-stage lag histograms and canary probe record — the headline
+    # bench (scripts/bench_visibility_headline.py) gates on these
+    visibility = {fs.name: {
+        "ledger": fs.node.ledger.stats()
+        if getattr(fs.node, "ledger", None) is not None else None,
+        "canary": fs.node.canary.stats()
+        if getattr(fs.node, "canary", None) is not None else None,
+    } for fs in h.live()}
     return {
         "harness": "loadgen-fleet",
         "servers": cfg.n_servers,
@@ -1697,6 +1706,7 @@ def _fleet_quiesce(h: _FleetHarness, sessions, giant_state,
             if h.chaos_pool is not None else None},
         "oracle": ost,
         "violations": violations,
+        "visibility": visibility,
         "prom_cluster_families": sorted(
             f for f in fams if f.startswith("crdt_cluster_")),
         # the replay line + fired-fault counters of the armed network

@@ -65,7 +65,8 @@ from http.client import HTTPConnection, HTTPException
 from typing import Dict, Optional
 
 from ..obs.trace import (AE_PEER_HEADER, SINCE_FOUND_HEADER,
-                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER)
+                         SINCE_MORE_HEADER, SINCE_NEXT_HEADER,
+                         TRACE_FRONTIER_HEADER)
 from ..serve.metrics import Histogram, LATENCY_BOUNDS_MS
 from ..serve.queue import QueueFull, SchedulerStopped
 from . import netchaos as netchaos_mod
@@ -484,6 +485,16 @@ class AntiEntropy(threading.Thread):
                     with self._lock:
                         st.ops_applied += applied
                     st.hw_digest[doc] = (since, etag)
+                    if applied and hasattr(self.node,
+                                           "note_ae_window"):
+                        # visible-at-replica (ISSUE 20): the window's
+                        # trace frontier names the commits it carried
+                        # and the peer's send timestamp — stamp
+                        # ae_apply spans + the ledger's replica-stage
+                        # bound on THIS (pulling) node
+                        self.node.note_ae_window(
+                            doc, st.name,
+                            resp.getheader(TRACE_FRONTIER_HEADER))
             nxt = resp.getheader(SINCE_NEXT_HEADER)
             if nxt is not None:
                 st.hw[doc] = int(nxt)

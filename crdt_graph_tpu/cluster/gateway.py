@@ -33,19 +33,24 @@ spin up — including ``crash()``, which drops the node the way a
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from http.client import HTTPConnection, HTTPException
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import canary as canary_mod
+from ..obs import fleettrace as fleettrace_mod
 from ..obs import flight as flight_mod
+from ..obs import ledger as ledger_mod
 from ..obs import prom as prom_mod
 from ..obs.trace import (AE_LAG_HEADER, FORWARDED_HEADER,
                          REPLICA_EPOCH_HEADER,
                          REPLICA_HEADER, REPLICA_NAME_HEADER,
                          SESSION_HEADER, SINCE_FOUND_HEADER,
                          SINCE_MORE_HEADER, SINCE_NEXT_HEADER,
-                         STATE_FP_HEADER, TRACE_HEADER)
+                         SPAN_CTX_HEADER, STATE_FP_HEADER,
+                         TRACE_HEADER, ensure_trace_id)
 from ..serve import ServingEngine
 from ..utils.hostenv import env_float as _env_float
 from . import kv as kv_mod
@@ -140,6 +145,20 @@ class ClusterNode:
         self.engine.external_stability = True
         for d in self.engine.docs():
             d.tree._log.set_auto_stable(False)
+        # fleet-wide causal tracing + write-to-visibility ledger
+        # (obs/fleettrace.py, obs/ledger.py; docs/OBSERVABILITY.md
+        # §Fleet tracing & visibility ledger): per-node like the
+        # flight recorder (in-process fleets share a process), wired
+        # onto the engine so record_commit stamps both at the seam
+        # every commit already crosses.  GRAFT_FLEETTRACE=0 leaves
+        # the objects in place but every stamp and wire header gated
+        # off, so the wire reverts to the PR-19 baseline.
+        self.fleettrace = fleettrace_mod.FleetTrace(name)
+        self.ledger = ledger_mod.VisibilityLedger(name)
+        self.engine.fleettrace = self.fleettrace
+        self.engine.ledger = self.ledger
+        # continuous canary probing (obs/canary.py): armed in start()
+        self.canary: Optional[canary_mod.CanaryProber] = None
         self._marks_lock = threading.Lock()
         self._peer_marks: Dict[str, Dict[str, int]] = {}
         self.leases = LeaseService(kv, ttl_s=ttl_s, max_ids=max_ids,
@@ -185,6 +204,16 @@ class ClusterNode:
         self.keeper.start()
         self.antientropy.start()
         self.refresh_ring()
+        # the canary prober runs by default on fleet nodes
+        # (GRAFT_CANARY=0 or a non-positive interval disables it; the
+        # first probe fires only after one full interval, so short
+        # test fleets never see one under the 30 s default)
+        if canary_mod.enabled():
+            try:
+                self.canary = canary_mod.CanaryProber(self).start()
+            except Exception:   # noqa: BLE001 — observability must
+                # degrade, never refuse to serve
+                self.canary = None
         return self
 
     def _lease_changed(self, lease: Lease) -> None:
@@ -196,6 +225,8 @@ class ClusterNode:
         """``graceful=False`` models a crash: no lease release (the
         slot ages out over the TTL or is force-expired), no drain —
         exactly what a killed process leaves behind."""
+        if self.canary is not None:
+            self.canary.stop()
         self.antientropy.stop()
         if self.keeper is not None:
             self.keeper.stop()
@@ -277,6 +308,14 @@ class ClusterNode:
         never pin a client handler for retries × timeout."""
         detail = "no attempt"
         deadline = time.monotonic() + self.forward_budget_s
+        # mint-or-adopt the trace id HERE, not at the primary: a
+        # client write without an X-Trace-Id used to forward without
+        # one, so the primary minted its own and the forwarding node
+        # had no id to attribute the hop — ack and flight record
+        # disagreed.  One id now rides the relay and comes back on
+        # the ack no matter which node commits (stable across
+        # retries, so a failover retry stays attributable too).
+        tid = ensure_trace_id(headers.get(TRACE_HEADER))
         for attempt in range(self.forward_retries):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -297,11 +336,16 @@ class ClusterNode:
             host, port = addr.rsplit(":", 1)
             try:
                 fwd = {"Content-Type": "application/json",
-                       FORWARDED_HEADER: f"{self.name}.{self.epoch()}"}
-                for h in (TRACE_HEADER, SESSION_HEADER):
-                    v = headers.get(h)
-                    if v:
-                        fwd[h] = v
+                       FORWARDED_HEADER: f"{self.name}.{self.epoch()}",
+                       TRACE_HEADER: tid}
+                v = headers.get(SESSION_HEADER)
+                if v:
+                    fwd[SESSION_HEADER] = v
+                if fleettrace_mod.enabled():
+                    fwd[SPAN_CTX_HEADER] = \
+                        fleettrace_mod.encode_span_ctx(
+                            self.name, "forward")
+                t_req = time.perf_counter()
                 # pooled relay (cluster/pool.py): a stale keep-alive
                 # connection retries once inside the pool (the relayed
                 # POST is idempotent — the CRDT absorbs a duplicate);
@@ -317,6 +361,13 @@ class ClusterNode:
                 out_headers = {h: resp.getheader(h)
                                for h in _RELAY_HEADERS
                                if resp.getheader(h)}
+                # the ack always carries the id the relay rode under
+                # (a primary running an older build might not echo)
+                out_headers.setdefault(TRACE_HEADER, tid)
+                self.fleettrace.record(
+                    tid, "forward", doc=doc_id, peer=primary,
+                    ms=round((time.perf_counter() - t_req) * 1e3, 3),
+                    status=resp.status)
                 # 429 passes straight through (Retry-After intact):
                 # the PRIMARY's admission queue is the fleet's
                 # backpressure signal, not something to absorb here
@@ -413,6 +464,107 @@ class ClusterNode:
 
     def note_forwarded_in(self) -> None:
         self._count("forwarded_in")
+
+    # -- fleet tracing + visibility (docs/OBSERVABILITY.md) ----------------
+
+    def note_span_ctx(self, trace_id: str,
+                      ctx_header: Optional[str]) -> None:
+        """An inbound request carried ``X-Span-Ctx`` (service/http.py
+        hands it through): record the receiving half of the hop, with
+        the cross-clock transport delta as a BOUND."""
+        ctx = fleettrace_mod.parse_span_ctx(ctx_header)
+        if ctx is None:
+            return
+        sender, kind, send_ts_ms = ctx
+        bound_ms = round(max(0.0, time.time() - send_ts_ms / 1e3)
+                         * 1e3, 3)
+        self.fleettrace.record(trace_id, kind, peer=sender,
+                               bound_ms=bound_ms, dir="in")
+
+    def note_ae_window(self, doc_id: str, peer: str,
+                       frontier_header: Optional[str]) -> None:
+        """An anti-entropy window from ``peer`` just applied locally
+        and carried a trace frontier: stamp visible-at-replica on this
+        (pulling) node — ``ae_apply`` spans for the commits the window
+        carried, and the ledger's replica-stage bound."""
+        parsed = fleettrace_mod.parse_frontier(frontier_header)
+        if parsed is None or not fleettrace_mod.enabled():
+            return
+        send_ts_ms, tids = parsed
+        bound_ms = round(max(0.0, time.time() - send_ts_ms / 1e3)
+                         * 1e3, 3)
+        for tid in tids:
+            self.fleettrace.record(tid, "ae_apply", doc=doc_id,
+                                   peer=peer, bound_ms=bound_ms)
+        self.ledger.note_replica_apply(doc_id, peer, send_ts_ms, tids)
+
+    def note_watch_delivery(self, doc_id: str, seq: int) -> None:
+        """First watch delivery of generation ``seq`` (the hook
+        ``serve.watch.delivery_headers`` calls — threaded and reactor
+        egress share that one builder): delivered-to-watchers in the
+        ledger plus a ``watch_delivery`` span per commit trace id."""
+        if not fleettrace_mod.enabled():
+            return
+        tids = self.ledger.note_watch_delivery(doc_id, seq)
+        if tids:
+            for tid in tids:
+                self.fleettrace.record(tid, "watch_delivery",
+                                       doc=doc_id, seq=seq)
+
+    def trace_frontier_header(self, doc_id: str) -> Optional[str]:
+        """The ``X-Trace-Frontier`` stamp for a windowed ``/ops``
+        response (service/http.py adds it to both the buffered and
+        the sendfile-plan paths); None when the tier is off."""
+        return self.fleettrace.frontier_header(doc_id)
+
+    def debug_trace(self, trace_id: str,
+                    federate: bool = True) -> Dict:
+        """``GET /debug/trace/{id}``: this node's spans, plus — when
+        federating — ONE bounded fetch per live peer (``?federate=0``
+        stops recursion) merged into a wall-clock-ordered span tree.
+        Cross-node ordering rides wall clocks, so it is a display
+        order, not a truth (the skew caveat)."""
+        local = self.fleettrace.spans(trace_id)
+        out: Dict = {"trace_id": trace_id, "node": self.name,
+                     "spans": local, "peers": {}}
+        if not federate or not fleettrace_mod.enabled():
+            return out
+        members = self.members()
+        names = set(members) | set(
+            self.fleettrace.known_nodes(trace_id))
+        for peer in sorted(names - {self.name}):
+            lease = members.get(peer)
+            if lease is None:
+                continue
+            host, port = lease.addr.rsplit(":", 1)
+            try:
+                resp, body = self.pool.request(
+                    self.name, peer, host, int(port), "GET",
+                    f"/debug/trace/{trace_id}?federate=0",
+                    timeout=5.0)
+                if resp.status != 200:
+                    out["peers"][peer] = None
+                    continue
+                remote = json.loads(body)
+                out["peers"][peer] = remote.get("spans", [])
+                self.fleettrace.federated_fetches += 1
+            except (OSError, HTTPException, ValueError):
+                out["peers"][peer] = None
+        merged = list(local)
+        for spans in out["peers"].values():
+            if spans:
+                merged.extend(spans)
+        merged.sort(key=lambda s: s.get("t_wall", 0.0))
+        out["tree"] = merged
+        out["kinds"] = sorted({s.get("kind") for s in merged
+                               if s.get("kind")})
+        out["skew_note"] = ("cross-node ordering uses wall clocks — "
+                            "a display order, not a truth")
+        return out
+
+    def debug_visibility(self, doc_id: str) -> Dict:
+        """``GET /debug/visibility/{doc}``: the ledger tail."""
+        return self.ledger.tail(doc_id)
 
     # -- rejoining-node catch-up (ISSUE 9) ---------------------------------
 
@@ -676,6 +828,15 @@ class ClusterNode:
             # pooled inter-node connections (cluster/pool.py)
             "connpool": self.pool.stats(),
             "last_repair_err": self._last_repair_err,
+            # fleet tracing + visibility + canary (ISSUE 20): None
+            # when the tier is off, so the prom families disappear
+            # with it — and they never exist on non-fleet engines
+            "fleettrace": self.fleettrace.stats()
+            if fleettrace_mod.enabled() else None,
+            "visibility": self.ledger.stats()
+            if fleettrace_mod.enabled() else None,
+            "canary": self.canary.stats()
+            if self.canary is not None else None,
         }
 
     def cluster_view(self) -> Dict:

@@ -212,14 +212,18 @@ class MergeTierClient:
             timeout=timeout)
         return resp.status, raw
 
-    def merge_one(self, doc_id: str, p, num_new: int):
+    def merge_one(self, doc_id: str, p, num_new: int,
+                  trace_ctx: Optional[Dict] = None):
         """One document's remote merge: encode → send → verify.
-        Returns ``(table, shared_capacity, width)`` or raises
-        :class:`MergeFallback` with the ladder rung that broke."""
+        Returns ``(table, shared_capacity, width, sub)`` — ``sub`` is
+        the traced round's transport/queue/launch split (None unless
+        ``trace_ctx`` rode out AND the worker echoed its timings) — or
+        raises :class:`MergeFallback` with the ladder rung that broke."""
         import socket
         from http.client import HTTPException
         t0 = time.perf_counter()
-        body = wire.encode_request(doc_id, p, num_new)
+        body = wire.encode_request(doc_id, p, num_new,
+                                   trace_meta=trace_ctx)
         digest = wire.request_digest(p)
         try:
             w = self._pick()
@@ -273,26 +277,45 @@ class MergeTierClient:
         with self._mu:
             self.remote_docs += 1
             self.remote_ops += int(num_new)
-        self.remote_ms.observe((time.perf_counter() - t0) * 1e3)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        self.remote_ms.observe(total_ms)
         self.width_hist.observe(width)
-        return table, shared, width
+        sub = None
+        if trace_ctx is not None:
+            try:
+                wm = meta.get("worker_ms")
+                if wm is not None:
+                    wait = float(wm.get("wait", 0.0))
+                    sub = {"transport": round(max(0.0, total_ms - wait),
+                                              3),
+                           "queue": float(wm.get("queue", 0.0)),
+                           "launch": float(wm.get("launch", 0.0)),
+                           "worker": str(meta.get("worker",
+                                                  w.endpoint))}
+            except (TypeError, ValueError, AttributeError):
+                sub = None
+        return table, shared, width, sub
 
     # -- one scheduler round -----------------------------------------------
 
-    def merge_round(self, items: Sequence[Tuple[str, Any, int]]
+    def merge_round(self, items: Sequence[Tuple]
                     ) -> List[Any]:
         """Fan one round's documents out concurrently (so they ride
         ONE worker linger window even from a single front-end) and
-        return, per item, either ``(table, shared, width)`` or the
-        :class:`MergeFallback` that stopped it.  Never raises — every
-        slot gets an answer the scheduler can act on."""
+        return, per item, either ``(table, shared, width, sub)`` or
+        the :class:`MergeFallback` that stopped it.  Never raises —
+        every slot gets an answer the scheduler can act on.  Items are
+        ``(doc_id, p, num_new)`` or ``(doc_id, p, num_new,
+        trace_ctx)``."""
         with self._mu:
             self.remote_rounds += 1
         results: List[Any] = [None] * len(items)
 
-        def one(i: int, doc_id: str, p, num_new: int) -> None:
+        def one(i: int, doc_id: str, p, num_new: int,
+                trace_ctx: Optional[Dict] = None) -> None:
             try:
-                results[i] = self.merge_one(doc_id, p, num_new)
+                results[i] = self.merge_one(doc_id, p, num_new,
+                                            trace_ctx=trace_ctx)
             except MergeFallback as e:
                 results[i] = e
 
@@ -300,8 +323,8 @@ class MergeTierClient:
             one(0, *items[0])
             return results
         threads = [threading.Thread(
-            target=one, args=(i, d, p, n), daemon=True)
-            for i, (d, p, n) in enumerate(items)]
+            target=one, args=(i, *it), daemon=True)
+            for i, it in enumerate(items)]
         for t in threads:
             t.start()
         deadline = time.monotonic() + self.budget_s + 1.0

@@ -81,11 +81,15 @@ def frame_digest(table: NodeTable) -> str:
     return _sha1_arrays(getattr(table, f) for f in _TABLE_FIELDS)
 
 
-def encode_request(doc_id: str, p: PackedOps, num_new: int) -> bytes:
-    """Pack one document's prepared candidate set for ``POST /merge``."""
+def encode_request(doc_id: str, p: PackedOps, num_new: int,
+                   trace_meta: Dict = None) -> bytes:
+    """Pack one document's prepared candidate set for ``POST /merge``.
+    ``trace_meta`` (fleet tracing, ISSUE 20: the commit's trace ids +
+    the sender's ``X-Span-Ctx`` twin) rides as one extra meta key and
+    is omitted entirely when None — with ``GRAFT_FLEETTRACE=0`` the
+    request bytes are identical to the PR-19 wire."""
     from .. import engine as engine_mod
-    buf = io.BytesIO()
-    engine_mod.write_packed_npz(buf, p, {
+    meta = {
         "fmt": FORMAT_VERSION,
         "num_ops": int(p.num_ops),
         "hints_vouched": bool(p.hints_vouched),
@@ -93,7 +97,11 @@ def encode_request(doc_id: str, p: PackedOps, num_new: int) -> bytes:
         "num_new": int(num_new),
         "capacity": int(p.capacity),
         "input_digest": request_digest(p),
-    }, compress=False)
+    }
+    if trace_meta is not None:
+        meta["trace"] = trace_meta
+    buf = io.BytesIO()
+    engine_mod.write_packed_npz(buf, p, meta, compress=False)
     return buf.getvalue()
 
 
@@ -125,9 +133,12 @@ def decode_request(body: bytes) -> Tuple[PackedOps, Dict]:
 
 
 def encode_response(table: NodeTable, shared_capacity: int, width: int,
-                    input_digest: str) -> bytes:
+                    input_digest: str, extra: Dict = None) -> bytes:
     """Worker-side encode of one document's slice of the batched
-    launch (host numpy by now — the caller slices + device_get)."""
+    launch (host numpy by now — the caller slices + device_get).
+    ``extra`` (the worker's queue/launch sub-stage timings — echoed
+    only when the request carried trace context) merges into meta;
+    None keeps the response bytes on the PR-19 baseline."""
     arrays = {f"t_{f}": np.asarray(getattr(table, f))
               for f in _TABLE_FIELDS}
     meta = {"fmt": FORMAT_VERSION,
@@ -135,6 +146,8 @@ def encode_response(table: NodeTable, shared_capacity: int, width: int,
             "width": int(width),
             "input_digest": str(input_digest),
             "frame_digest": frame_digest(table)}
+    if extra:
+        meta.update(extra)
     buf = io.BytesIO()
     np.savez(buf, meta=np.frombuffer(json.dumps(meta).encode(),
                                      np.uint8), **arrays)

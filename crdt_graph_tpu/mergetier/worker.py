@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -68,6 +69,11 @@ class MergeWorker:
         self._meshes: Dict[int, object] = {}
         self._lock = threading.Lock()
         self._dead = False
+        # wall time of the most recent epoch launch (ms) — echoed to
+        # traced requests so the front-end can split its remote_merge
+        # stage into transport vs queue vs launch without a worker-side
+        # clock crossing
+        self._last_launch_ms = 0.0
         self.requests = 0
         self.merged_docs = 0
         self.merged_ops = 0
@@ -96,11 +102,14 @@ class MergeWorker:
         slice must not pin the whole batched table in device memory."""
         import jax
         from ..parallel import mesh as mesh_mod
+        t0 = time.perf_counter()
         prepared = [p for p, _ in cargo]
         stacked, aligned = mesh_mod.stack_aligned(prepared)
         btab = mesh_mod.batched_materialize(
             stacked, self._mesh_for(len(cargo)))
         host = jax.tree.map(np.asarray, jax.device_get(btab))
+        with self._lock:
+            self._last_launch_ms = (time.perf_counter() - t0) * 1e3
         width = len(cargo)
         shared = aligned[0].capacity
         self.width_hist.observe(width)
@@ -132,6 +141,7 @@ class MergeWorker:
                 {"Content-Type": "application/json"}
         with self._lock:
             self.requests += 1
+        t_sub = time.perf_counter()
         try:
             table, shared, width = self.batcher.submit((p, meta))
         except Exception as e:   # noqa: BLE001 — a failed epoch must
@@ -145,8 +155,23 @@ class MergeWorker:
         with self._lock:
             self.merged_docs += 1
             self.merged_ops += p.num_ops
+            last_launch_ms = self._last_launch_ms
+        extra = None
+        if meta.get("trace") is not None:
+            # split this request's in-worker wait into linger-queue vs
+            # launch using monotonic durations only (never a clock
+            # crossing): the epoch's launch time caps at the wait —
+            # whatever precedes it inside the wait was the queue
+            wait_ms = (time.perf_counter() - t_sub) * 1e3
+            launch_ms = min(last_launch_ms, wait_ms)
+            extra = {"worker": self.name,
+                     "worker_ms": {
+                         "wait": round(wait_ms, 3),
+                         "queue": round(max(0.0, wait_ms - launch_ms),
+                                        3),
+                         "launch": round(launch_ms, 3)}}
         resp = wire.encode_response(table, shared, width,
-                                    meta["input_digest"])
+                                    meta["input_digest"], extra=extra)
         return 200, resp, {"Content-Type": "application/octet-stream"}
 
     # -- lifecycle / telemetry --------------------------------------------

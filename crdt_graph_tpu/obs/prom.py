@@ -1040,6 +1040,82 @@ def render_cluster(node) -> str:
             w.sample("crdt_netchaos_faults_total",
                      "crdt_netchaos_faults_total",
                      nc["counters"][kind], {"kind": kind})
+    # fleet tracing + visibility ledger + canary (ISSUE 20;
+    # docs/OBSERVABILITY.md §Fleet tracing & visibility ledger) —
+    # every family below is ABSENT under GRAFT_FLEETTRACE=0 /
+    # GRAFT_CANARY=0 (cluster_stats nulls the sections), the same
+    # disabled-tier contract the netchaos families keep
+    ft = cs.get("fleettrace")
+    if ft is not None:
+        w.gauge("crdt_fleettrace_traces",
+                "Trace ids held in this node's span ring",
+                ft["traces"])
+        w.counter("crdt_fleettrace_evicted_traces_total",
+                  "Traces FIFO-evicted from the bounded span ring",
+                  ft["evicted_traces"])
+        w.counter("crdt_fleettrace_federated_fetches_total",
+                  "Peer fetches made assembling /debug/trace trees",
+                  ft["federated_fetches"])
+        w.family("crdt_fleettrace_spans_total", "counter",
+                 "Causal spans recorded on this node, by hop kind")
+        for kind in sorted(ft["spans_by_kind"]):
+            w.sample("crdt_fleettrace_spans_total",
+                     "crdt_fleettrace_spans_total",
+                     ft["spans_by_kind"][kind], {"kind": kind})
+    vis = cs.get("visibility")
+    if vis is not None:
+        w.counter("crdt_visibility_commits_total",
+                  "Commits entered into the visibility ledger",
+                  vis["commits"])
+        w.counter("crdt_visibility_replica_applies_total",
+                  "Anti-entropy frontier applies stamped on this "
+                  "node as the puller", vis["replica_applies"])
+        w.counter("crdt_visibility_skew_clamped_total",
+                  "Cross-node lag bounds clamped at zero (negative "
+                  "clock skew)", vis["skew_clamped"])
+        if vis["lag"]:
+            w.family("crdt_visibility_lag_seconds", "histogram",
+                     "Write-to-visibility lag by stage (cross-node "
+                     "stages are one-way BOUNDS, not truths)")
+            for row in vis["lag"]:
+                h = row["hist"]
+                w.histogram("crdt_visibility_lag_seconds",
+                            "Write-to-visibility lag by stage",
+                            h["bounds"], h["counts"], h["count"],
+                            h["sum"], {"stage": row["stage"],
+                                       "peer": row["peer"]})
+    can = cs.get("canary")
+    if can is not None:
+        w.counter("crdt_canary_probes_total",
+                  "Synthetic canary probes written through the real "
+                  "admission path", can["probes"])
+        w.counter("crdt_canary_slo_breaches_total",
+                  "Probes with a stage over GRAFT_CANARY_SLO_MS",
+                  can["slo_breaches"])
+        w.family("crdt_canary_failures_total", "counter",
+                 "Canary hop failures, by hop")
+        for hop in sorted(can["failures"]):
+            w.sample("crdt_canary_failures_total",
+                     "crdt_canary_failures_total",
+                     can["failures"][hop], {"hop": hop})
+        h = can["e2e"]
+        if h.get("count"):
+            w.family("crdt_canary_visibility_seconds", "histogram",
+                     "Canary write-to-global-visibility, end to end")
+            w.histogram("crdt_canary_visibility_seconds",
+                        "Canary write-to-global-visibility, end to "
+                        "end", h["bounds"], h["counts"], h["count"],
+                        h["sum"])
+        if can["stages"]:
+            w.family("crdt_canary_stage_seconds", "histogram",
+                     "Canary per-stage visibility lag "
+                     "(ack/watch/peer_first/peer_all)")
+            for stage in sorted(can["stages"]):
+                h = can["stages"][stage]
+                w.histogram("crdt_canary_stage_seconds",
+                            "Canary per-stage visibility lag",
+                            h["bounds"], h["counts"], h["count"],
+                            h["sum"], {"stage": stage})
     return w.render()
 
 

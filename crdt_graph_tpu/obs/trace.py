@@ -79,6 +79,20 @@ MAX_STALENESS_HEADER = "X-Max-Staleness"
 # never silent data loss
 WATCH_EVENT_HEADER = "X-Watch-Event"
 WATCH_RESUME_HEADER = "X-Watch-Resume-Since"
+# fleet-wide causal tracing (obs/fleettrace.py; docs/OBSERVABILITY.md
+# §Fleet tracing & visibility ledger): X-Span-Ctx rides every
+# inter-node hop a write takes (gateway forward, mergetier POST
+# /merge, the canary's peer probes) naming the sending node, the hop
+# kind, and the send timestamp — the receiving side appends its span
+# under the same trace id so `GET /debug/trace/{id}` on ANY node can
+# stitch the full causal tree.  X-Trace-Frontier is the anti-entropy
+# twin: a windowed `/ops` response stamps the trace ids of the recent
+# commits the window carries (plus the primary's send timestamp), so
+# the PULLING node can stamp visible-at-replica without a new RPC.
+# Both headers are emitted only while fleet tracing is enabled
+# (GRAFT_FLEETTRACE=0 reverts the wire byte-identically).
+SPAN_CTX_HEADER = "X-Span-Ctx"
+TRACE_FRONTIER_HEADER = "X-Trace-Frontier"
 # rejoining-node catch-up (ISSUE 9): a fleet read of a document this
 # node doesn't hold yet — but a peer does — answers 503 + Retry-After
 # instead of 404, with this hint: the best local estimate of the ops

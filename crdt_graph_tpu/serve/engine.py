@@ -830,6 +830,11 @@ class ServingEngine:
         # a SessionOracle attached via oracle.attach_engine() — renders
         # the crdt_oracle_* prom families when present
         self.oracle: Optional[oracle_mod.SessionOracle] = None
+        # fleet-wide tracing + visibility ledger (obs/fleettrace.py,
+        # obs/ledger.py): a ClusterNode wires both; single-engine
+        # deployments leave them None and record_commit pays nothing
+        self.fleettrace = None
+        self.ledger = None
         # -- pipelined commit path (serve/workers.py; ISSUE 12) ----------
         # GRAFT_PIPELINE=0 restores the fully serialized scheduler
         # (every round: compute → fsync → publish → maintenance on one
@@ -1120,6 +1125,42 @@ class ServingEngine:
             })
         except Exception:            # noqa: BLE001 — recorder boundary
             self.counters.add("flight_record_errors")
+        if self.fleettrace is not None or self.ledger is not None:
+            try:
+                self._stamp_visibility(doc, ct)
+            except Exception:        # noqa: BLE001 — same boundary:
+                # tracing must never take down the scheduler
+                self.counters.add("fleettrace_stamp_errors")
+
+    def _stamp_visibility(self, doc: ServedDoc,
+                          ct: trace_mod.CommitTrace) -> None:
+        """Fleet-node commit stamping (docs/OBSERVABILITY.md §Fleet
+        tracing & visibility ledger): register the local admission →
+        fsync → publish spans for every trace id the fused commit
+        served, append the visibility-ledger entry, and fold the
+        trace ids into the doc's anti-entropy trace frontier — ONE
+        seam, the same one that feeds the flight recorder."""
+        from ..obs import fleettrace as fleettrace_mod
+        if not fleettrace_mod.enabled() \
+                or ct.outcome not in ("committed", "partial"):
+            return
+        stages = ct.stage_breakdown()
+        wal_ms = sum(v for k, v in stages.items()
+                     if k.startswith("wal_"))
+        durable_ms = round(wal_ms, 3) if wal_ms > 0.0 else None
+        seq = doc.snapshot_view().seq
+        total_ms = round(ct.total_ms, 3)
+        ft = self.fleettrace
+        if ft is not None:
+            for tid in ct.trace_ids:
+                ft.record(tid, "admission", doc=ct.doc_id, seq=seq)
+                if durable_ms is not None:
+                    ft.record(tid, "fsync", ms=durable_ms)
+                ft.record(tid, "publish", ms=total_ms, seq=seq)
+            ft.note_commit(ct.doc_id, ct.trace_ids)
+        if self.ledger is not None:
+            self.ledger.record_commit(ct.doc_id, seq, ct.trace_ids,
+                                      durable_ms, ct.total_ms)
 
     def presample_audit(self, ct: trace_mod.CommitTrace) -> None:
         """Pipelined rounds sample the chain audit on the SCHEDULER

@@ -46,6 +46,7 @@ import numpy as np
 from .. import wal as wal_mod
 from ..codec import packed as packed_mod
 from ..core.errors import CRDTError
+from ..obs import fleettrace as fleettrace_mod
 from ..obs.trace import CommitTrace
 from ..utils import profiling
 from .queue import (SchedulerError, SchedulerStopped, WalUnavailable,
@@ -1096,10 +1097,22 @@ class MergeScheduler(threading.Thread):
             reqs.append((item, prep))
         sendable = [(item, prep) for item, prep in reqs
                     if prep is not None]
+        ft = self.engine.fleettrace
+        traced = fleettrace_mod.enabled()
+        src = ft.node if ft is not None else mt.src
+
+        def _tctx(ct):
+            if not traced or not ct.trace_ids:
+                return None
+            return {"trace_ids": list(ct.trace_ids)[:8],
+                    "span_ctx": fleettrace_mod.encode_span_ctx(
+                        src, "remote_merge")}
+
         t0 = time.perf_counter()
         with profiling.span("serve.remote_merge"):
             results = mt.merge_round(
-                [(item[0].doc_id, prep, item[2].num_ops)
+                [(item[0].doc_id, prep, item[2].num_ops,
+                  _tctx(item[4]))
                  for item, prep in sendable])
         remote_ms = round((time.perf_counter() - t0) * 1e3, 3)
         # crash site: responses in hand, nothing committed or acked —
@@ -1114,8 +1127,23 @@ class MergeScheduler(threading.Thread):
             ct.stages_ms["remote_merge"] = remote_ms
             res = outcome[id(item)]
             if isinstance(res, tuple):
-                table, shared, width = res
+                table, shared, width, sub = res
                 ct.batch_width = width
+                if sub is not None:
+                    # the worker's echoed split (satellite: transport
+                    # vs linger-queue vs launch inside remote_merge)
+                    ct.stages_ms["remote_transport"] = sub["transport"]
+                    ct.stages_ms["remote_queue"] = sub["queue"]
+                    ct.stages_ms["remote_launch"] = sub["launch"]
+                    if ft is not None:
+                        for tid in list(ct.trace_ids)[:8]:
+                            ft.record(tid, "remote_merge",
+                                      doc=item[0].doc_id,
+                                      worker=sub["worker"],
+                                      ms=remote_ms,
+                                      transport_ms=sub["transport"],
+                                      queue_ms=sub["queue"],
+                                      launch_ms=sub["launch"])
                 p = pk.with_capacity(prep, shared)
                 self._guarded(self._finish_grouped, item, p, table)
             else:

@@ -122,6 +122,16 @@ def delivery_headers(store, snap, meta: Dict[str, Any], since: int,
     }
     if hasattr(store, "extra_read_headers"):
         out.update(store.extra_read_headers(snap, ae_lag_hdr=None))
+    if hasattr(store, "note_watch_delivery"):
+        # visibility ledger (ISSUE 20): the FIRST delivery of this
+        # generation is the delivered-to-watchers edge.  One stamp
+        # site because this is the one builder both delivery tiers
+        # share; the ledger dedups repeats, and a stamp failure must
+        # never cost a delivery.
+        try:
+            store.note_watch_delivery(snap.doc_id, snap.seq)
+        except Exception:   # noqa: BLE001
+            pass
     out[SINCE_FOUND_HEADER] = "1" if meta["found"] else "0"
     out[SINCE_MORE_HEADER] = "1" if meta["more"] else "0"
     if meta["next_since"] is not None:

@@ -105,6 +105,7 @@ from ..obs.trace import (AE_LAG_HEADER, AE_PEER_HEADER,
                          SESSION_HEADER,
                          SINCE_FOUND_HEADER, SINCE_MORE_HEADER,
                          SINCE_NEXT_HEADER, SNAP_FP_HEADER,
+                         SPAN_CTX_HEADER, TRACE_FRONTIER_HEADER,
                          TRACE_HEADER, WATCH_EVENT_HEADER,
                          WATCH_RESUME_HEADER, ensure_session_id,
                          ensure_trace_id, is_valid_id)
@@ -205,6 +206,13 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             if meta["next_since"] is not None:
                 hdrs[SINCE_NEXT_HEADER] = str(meta["next_since"])
             hdrs["ETag"] = meta["etag"]
+            # same frontier stamp as the buffered branch — the two
+            # /ops paths must carry identical headers (ISSUE 20)
+            if hasattr(store, "trace_frontier_header"):
+                tf = store.trace_frontier_header(
+                    getattr(doc, "doc_id", None))
+                if tf:
+                    hdrs[TRACE_FRONTIER_HEADER] = tf
             if etag_matches(self.headers.get("If-None-Match"),
                             meta["etag"]):
                 if hasattr(doc, "readcache"):
@@ -571,6 +579,25 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                     # the flight recorder's ring + counters, enriched
                     # for post-mortem without waiting for a dump file
                     self._send(200, store.debug_flight())
+                elif sub.startswith("/debug/trace/") and \
+                        hasattr(store, "debug_trace"):
+                    # fleet trace assembly (docs/OBSERVABILITY.md
+                    # §Fleet tracing): this node's spans for the id
+                    # plus — unless ?federate=0, which is what the
+                    # federated fetch itself sends so assembly is one
+                    # bounded hop, never recursive — every peer's
+                    tid = sub[len("/debug/trace/"):]
+                    fed = query.get("federate", ["1"])[0] != "0"
+                    self._send(200, store.debug_trace(
+                        tid, federate=fed))
+                elif sub.startswith("/debug/visibility/") and \
+                        hasattr(store, "debug_visibility"):
+                    # the visibility ledger's per-doc tail: when each
+                    # recent commit became durable / published /
+                    # watch-delivered here, plus frontier applies
+                    # pulled from peers (bounds, not truths)
+                    self._send(200, store.debug_visibility(
+                        sub[len("/debug/visibility/"):]))
                 elif sub == "/docs":
                     self._send(200, {"docs": store.ids()})
                 elif sub == "/cluster" and \
@@ -707,6 +734,15 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
                                if meta["next_since"] is not None
                                else {}),
                         }
+                        # fleet tracing (ISSUE 20): the window rides
+                        # out with our send timestamp + the doc's
+                        # recent commit trace ids so the PULLING node
+                        # can stamp ae_apply spans and a visibility
+                        # BOUND; absent under GRAFT_FLEETTRACE=0
+                        if hasattr(store, "trace_frontier_header"):
+                            tf = store.trace_frontier_header(doc_id)
+                            if tf:
+                                hdrs[TRACE_FRONTIER_HEADER] = tf
                         # conditional window pull (ISSUE 16 satellite):
                         # the window's content fingerprint is its ETag,
                         # so a steady-state anti-entropy re-pull of an
@@ -947,6 +983,12 @@ def make_handler(store: DocumentStore, max_body: int = DEFAULT_MAX_BODY):
             # and is echoed in the response (body + header) so a client
             # report joins against the server-side record
             trace_id = ensure_trace_id(self.headers.get(TRACE_HEADER))
+            # fleet tracing (ISSUE 20): a forwarded write carries the
+            # sender's X-Span-Ctx — splice its hop into OUR span ring
+            # under the shared trace id before the commit's own spans
+            span_ctx = self.headers.get(SPAN_CTX_HEADER)
+            if span_ctx and hasattr(store, "note_span_ctx"):
+                store.note_span_ctx(trace_id, span_ctx)
             trace_hdr = {TRACE_HEADER: trace_id}
             # echo a client-supplied session id on writes too, so one
             # session's whole request stream correlates on both paths

@@ -1,0 +1,474 @@
+"""Fleet-wide causal tracing + write-to-visibility ledger + canary
+probing (crdt_graph_tpu/obs/fleettrace.py, ledger.py, canary.py;
+ISSUE 20): cross-process trace propagation on every inter-node path,
+the ``/debug/trace/{id}`` federated span tree, the per-stage
+visibility-lag ledger, the ``crdt_fleettrace_*`` / ``crdt_visibility_*``
+/ ``crdt_canary_*`` exposition under the strict prom naming contract,
+the ``GRAFT_FLEETTRACE=0`` wire-revert, and the netchaos leg proving
+the canary's numbers honestly reflect an injected link delay.
+"""
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from crdt_graph_tpu.cluster import FleetServer, MemoryKV
+from crdt_graph_tpu.cluster import netchaos as netchaos_mod
+from crdt_graph_tpu.codec import json_codec
+from crdt_graph_tpu.codec import packed as packed_mod
+from crdt_graph_tpu.core.operation import Add, Batch
+from crdt_graph_tpu.mergetier import wire
+from crdt_graph_tpu.obs import canary as canary_mod
+from crdt_graph_tpu.obs import fleettrace as fleettrace_mod
+from crdt_graph_tpu.obs import prom as prom_mod
+from crdt_graph_tpu.obs.trace import (SPAN_CTX_HEADER,
+                                      TRACE_FRONTIER_HEADER,
+                                      TRACE_HEADER)
+from crdt_graph_tpu.serve import ServingEngine
+
+
+def ts(r, c):
+    return r * 2**32 + c
+
+
+def req(port, method, path, body=None, headers=None, timeout=60):
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, raw, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _spawn_fleet(kv, names, engine_factory=None, **kw):
+    """Deterministic fleet (test_cluster.py's shape): huge TTL,
+    dormant anti-entropy daemon — tests drive ``sync_now``."""
+    fleet = {}
+    for n in names:
+        eng = engine_factory(n) if engine_factory is not None else None
+        fleet[n] = FleetServer(n, kv, engine=eng, ttl_s=600.0,
+                               ae_interval_s=3600.0, **kw)
+    for fs in fleet.values():
+        fs.node.refresh_ring()
+    return fleet
+
+
+def _stop_fleet(fleet):
+    for fs in fleet.values():
+        try:
+            fs.stop()
+        except Exception:  # noqa: BLE001 — teardown boundary
+            pass
+
+
+def _doc_owned_by(ring, owner, prefix="doc"):
+    for i in range(500):
+        d = f"{prefix}{i}"
+        if ring.primary(d) == owner:
+            return d
+    pytest.fail(f"no doc routed to {owner}")
+
+
+def _chain(rid, n, start=1, prev=0):
+    ops = []
+    for c in range(start, start + n):
+        ops.append(Add(ts(rid, c), (prev,), f"r{rid}:{c}"))
+        prev = ts(rid, c)
+    return json_codec.dumps(Batch(tuple(ops)))
+
+
+def chain_ops(rid, n):
+    prev = 0
+    ops = []
+    for c in range(1, n + 1):
+        ops.append(Add(ts(rid, c), (prev,), f"r{rid}:{c}"))
+        prev = ts(rid, c)
+    return Batch(tuple(ops))
+
+
+# -- unit: span ring, wire helpers -------------------------------------------
+
+
+def test_span_ring_fifo_bounded():
+    """Both rings are FIFO-bounded: old traces evict past max_traces,
+    old spans drop past max_spans — span state never grows with
+    commit count (the tentpole's memory contract)."""
+    ft = fleettrace_mod.FleetTrace("n0", max_traces=4, max_spans=3)
+    for i in range(6):
+        ft.record(f"t{i:08x}", "admission", seq=i)
+    assert ft.trace_count() == 4
+    assert ft.stats()["evicted_traces"] == 2
+    assert ft.spans("t00000000") == []          # FIFO-evicted
+    for j in range(5):
+        ft.record("t00000005", "publish", seq=j)
+    spans = ft.spans("t00000005")
+    assert len(spans) == 3                      # span ring bounded
+    # oldest spans dropped: the admission span and the first publishes
+    assert [s["seq"] for s in spans] == [2, 3, 4]
+
+
+def test_span_ctx_and_frontier_wire_helpers():
+    ctx = fleettrace_mod.encode_span_ctx("n0", "forward",
+                                         send_ts_ms=12345)
+    assert fleettrace_mod.parse_span_ctx(ctx) == ("n0", "forward",
+                                                  12345)
+    # garbage tolerated, never raised — tracing cannot fail a write
+    for bad in (None, "", "a;b", "a;b;c;d", ";;9", "a;b;NaNish"):
+        assert fleettrace_mod.parse_span_ctx(bad) is None
+    fr = fleettrace_mod.encode_frontier(999, ["ta", "tb"])
+    assert fleettrace_mod.parse_frontier(fr) == (999, ["ta", "tb"])
+    for bad in (None, "", "no-semicolon", "xx;ta"):
+        assert fleettrace_mod.parse_frontier(bad) is None
+
+
+def test_merge_wire_trace_meta_byte_identity():
+    """The merge request/response bytes with trace context omitted are
+    IDENTICAL to the PR-19 wire — the GRAFT_FLEETTRACE=0 revert is
+    byte-exact on the merge-tier leg by construction."""
+    p = packed_mod.pack(chain_ops(1, 64))
+    base = wire.encode_request("d0", p, p.num_ops)
+    assert wire.encode_request("d0", p, p.num_ops,
+                               trace_meta=None) == base
+    traced = wire.encode_request(
+        "d0", p, p.num_ops,
+        trace_meta={"trace_ids": ["t1"], "span_ctx": "n0;remote_merge;1"})
+    assert traced != base
+    _, meta = wire.decode_request(traced)
+    assert meta["trace"]["trace_ids"] == ["t1"]
+
+
+# -- satellite 1: forward-path trace propagation (the bugfix pin) ------------
+
+
+def test_forward_propagates_minted_trace_id_two_nodes():
+    """A client write WITHOUT an X-Trace-Id entering through a
+    non-primary: the forwarding node mints the id, the relay rides
+    under it, the primary commits under it, and the ack echoes it —
+    the forwarder's hop and the committing node's record agree on ONE
+    id (the bug: the relay used to forward without an id, so the
+    primary minted its own and the hop was unattributable)."""
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n1")
+        st, raw, hdr = req(fleet["n0"].port, "POST",
+                           f"/docs/{doc}/ops", body=_chain(7, 4))
+        assert st == 200, raw
+        payload = json.loads(raw)
+        tid = payload["trace_id"]
+        assert hdr["X-Trace-Id"] == tid
+        assert payload["served_by"]["name"] == "n1"
+        # the forwarding node attributed its hop under the SAME id
+        fwd_spans = fleet["n0"].node.fleettrace.spans(tid)
+        assert any(s["kind"] == "forward" and s["peer"] == "n1"
+                   for s in fwd_spans)
+        # the primary spliced the sender's X-Span-Ctx AND committed
+        # under the same id (admission + publish spans)
+        prim = fleet["n1"].node.fleettrace.spans(tid)
+        kinds = [s["kind"] for s in prim]
+        assert "forward" in kinds       # the received hop (dir=in)
+        assert "admission" in kinds and "publish" in kinds
+    finally:
+        _stop_fleet(fleet)
+
+
+# -- satellite 4 + tentpole acceptance: the five-hop federated tree ----------
+
+
+def test_debug_trace_stitches_five_hop_kinds_across_nodes(tmp_path):
+    """One forwarded, watched, anti-entropy-replicated write on a
+    durable 2-node fleet: ``GET /debug/trace/{id}`` on EITHER node
+    assembles the full cross-node causal tree with all five hop kinds
+    — admission, fsync, publish, ae_apply, watch_delivery — plus the
+    forward hop itself (the tentpole's acceptance bar)."""
+    kv = MemoryKV()
+    fleet = _spawn_fleet(
+        kv, ("n0", "n1"),
+        engine_factory=lambda n: ServingEngine(
+            durable_dir=str(tmp_path / n), wal_sync="batch"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n1")
+        # forwarded write (no client tid) — commits durably on n1
+        st, raw, hdr = req(fleet["n0"].port, "POST",
+                           f"/docs/{doc}/ops", body=_chain(9, 6))
+        assert st == 200, raw
+        tid = json.loads(raw)["trace_id"]
+        # watch delivery on the primary (an immediate resume delivery
+        # — the window already has ops — rides the one shared header
+        # builder, which stamps the ledger + watch_delivery span)
+        st, _, whdr = req(fleet["n1"].port, "GET",
+                          f"/docs/{doc}/watch?since=0&timeout=0.5")
+        assert st == 200
+        # anti-entropy: n0 pulls the window; its X-Trace-Frontier
+        # carries the commit's trace id + n1's send timestamp
+        assert fleet["n0"].node.antientropy.sync_now()["n1"] is True
+
+        for port in (fleet["n0"].port, fleet["n1"].port):
+            st, raw, _ = req(port, "GET", f"/debug/trace/{tid}")
+            assert st == 200
+            tree = json.loads(raw)
+            assert set(tree["kinds"]) >= {
+                "admission", "fsync", "publish", "ae_apply",
+                "watch_delivery", "forward"}, tree["kinds"]
+            nodes = {s["node"] for s in tree["tree"]}
+            assert nodes == {"n0", "n1"}
+            assert "skew_note" in tree
+        # ?federate=0 answers locally only (the recursion stopper)
+        st, raw, _ = req(fleet["n0"].port, "GET",
+                         f"/debug/trace/{tid}?federate=0")
+        local = json.loads(raw)
+        assert "tree" not in local
+        assert all(s["node"] == "n0" for s in local["spans"])
+
+        # the visibility ledger's debug tail: the primary holds the
+        # commit entry (durable + publish + watch stamped); the
+        # replica holds the frontier apply as a BOUND
+        st, raw, _ = req(fleet["n1"].port, "GET",
+                         f"/debug/visibility/{doc}")
+        tail = json.loads(raw)
+        assert tail["entries"], tail
+        ent = tail["entries"][-1]
+        assert ent["trace_ids"] == [tid]
+        assert ent["durable_ms"] is not None
+        assert ent["watch_ms"] is not None
+        st, raw, _ = req(fleet["n0"].port, "GET",
+                         f"/docs/{doc}/ops?since=0&limit=64")
+        assert st == 200
+        st, raw, _ = req(fleet["n0"].port, "GET",
+                         f"/debug/visibility/{doc}")
+        rtail = json.loads(raw)
+        assert any(r["peer"] == "n1" and tid in r["trace_ids"]
+                   and r["bound_s"] >= 0.0
+                   for r in rtail["remote_applies"]), rtail
+        assert "bounds" in rtail["skew_note"]
+    finally:
+        _stop_fleet(fleet)
+
+
+def test_ops_window_carries_trace_frontier_header():
+    """A windowed /ops response on a node that committed traced writes
+    carries X-Trace-Frontier (send_ts;tids) — and a full-log /ops
+    (no limit) does not grow new headers."""
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0",))
+    try:
+        st, raw, _ = req(fleet["n0"].port, "POST", "/docs/fd0/ops",
+                         body=_chain(3, 4))
+        assert st == 200
+        tid = json.loads(raw)["trace_id"]
+        st, _, hdr = req(fleet["n0"].port, "GET",
+                         "/docs/fd0/ops?since=0&limit=32")
+        assert st == 200
+        parsed = fleettrace_mod.parse_frontier(
+            hdr.get(TRACE_FRONTIER_HEADER))
+        assert parsed is not None and tid in parsed[1]
+    finally:
+        _stop_fleet(fleet)
+
+
+# -- satellite 3: prom round-trip + absence off the fleet --------------------
+
+
+def test_prom_families_roundtrip_and_absent_off_fleet(tmp_path):
+    """The new families survive the strict parser on a fleet node
+    (histogram bucket invariants included) and are ABSENT from a
+    non-fleet engine's scrape — same disabled-tier contract as the
+    netchaos families."""
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n1")
+        st, raw, _ = req(fleet["n0"].port, "POST",
+                         f"/docs/{doc}/ops", body=_chain(5, 4))
+        assert st == 200
+        # a watch delivery so the visibility histogram has samples
+        st, _, _ = req(fleet["n1"].port, "GET",
+                       f"/docs/{doc}/watch?since=0&timeout=0.5")
+        assert st == 200
+        for name, fs in fleet.items():
+            st, raw, _ = req(fs.port, "GET", "/metrics/prom")
+            assert st == 200
+            fams = prom_mod.parse_text(raw.decode())
+            assert "crdt_fleettrace_spans_total" in fams
+            assert "crdt_fleettrace_traces" in fams
+            assert "crdt_canary_probes_total" in fams
+        # the committing node's ledger rendered labeled histograms
+        st, raw, _ = req(fleet["n1"].port, "GET", "/metrics/prom")
+        fams = prom_mod.parse_text(raw.decode())
+        vis = fams["crdt_visibility_lag_seconds"]
+        assert vis["type"] == "histogram"
+        stages = {lbl["stage"] for _, lbl, _ in vis["samples"]
+                  if "stage" in lbl}
+        assert {"publish", "watch"} <= stages
+        spans = fams["crdt_fleettrace_spans_total"]
+        kinds = {lbl["kind"] for _, lbl, _ in spans["samples"]}
+        assert {"admission", "publish", "watch_delivery"} <= kinds
+    finally:
+        _stop_fleet(fleet)
+    # non-fleet engine: none of the fleet families exist
+    eng = ServingEngine(start=False)
+    try:
+        fams = prom_mod.parse_text(prom_mod.render_engine(eng))
+        assert not [f for f in fams
+                    if f.startswith(("crdt_fleettrace_",
+                                     "crdt_visibility_",
+                                     "crdt_canary_"))]
+    finally:
+        eng.close()
+
+
+def test_canary_honest_under_injected_delay():
+    """Netchaos leg: with a deterministic 250 ms delay on every
+    inter-node link, the canary's peer-visibility lag must report at
+    least that much — the canary measures the links real traffic
+    rides, so an injected delay is REQUIRED to show up (a canary that
+    hid it would be lying)."""
+    chaos = netchaos_mod.NetChaos(20, "delay=250-250@1")
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0", "n1"), netchaos=chaos,
+                         breaker_threshold=50)
+    try:
+        prober = fleet["n0"].node.canary
+        assert prober is not None     # default-on for fleet nodes
+        done = threading.Event()
+        rec = {}
+
+        def run_probe():
+            rec.update(prober.probe())
+            done.set()
+
+        t = threading.Thread(target=run_probe, daemon=True)
+        t.start()
+        # the probe confirms on n1 only after anti-entropy hands the
+        # canary doc over — drive pulls (over the delayed links) until
+        # the probe resolves
+        deadline = time.monotonic() + 30
+        while not done.is_set() and time.monotonic() < deadline:
+            fleet["n1"].node.antientropy.sync_now()
+            done.wait(0.1)
+        t.join(10)
+        assert rec.get("ok") is True, rec
+        assert rec["stages_s"]["peer_first"] >= 0.25, rec
+        assert rec["peers_s"]["n1"] >= 0.25
+        # the injected delay actually fired on the probed links
+        assert chaos.stats()["counters"]["delays"] > 0
+    finally:
+        _stop_fleet(fleet)
+
+
+def test_canary_default_on_and_periodic(monkeypatch):
+    """Canary default-ON acceptance: with a short interval the prober
+    arms at node start, fires through the REAL admission path, and the
+    crdt_canary_visibility_seconds histogram is non-empty after one
+    interval; GRAFT_CANARY=0 disarms."""
+    monkeypatch.setenv("GRAFT_CANARY_INTERVAL_S", "0.2")
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("solo",))
+    try:
+        prober = fleet["solo"].node.canary
+        assert prober is not None
+        # probes increments at probe START — wait for a finished
+        # record (it carries trace_id) so we don't race the first
+        # probe's JAX compile
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            cst = prober.stats()
+            if cst["last_probe"] and "trace_id" in cst["last_probe"]:
+                break
+            time.sleep(0.05)
+        assert cst["probes"] >= 1
+        assert cst["last_probe"]["ok"] is True, cst["last_probe"]
+        assert cst["e2e"]["count"] >= 1
+        st, raw, _ = req(fleet["solo"].port, "GET", "/metrics/prom")
+        fams = prom_mod.parse_text(raw.decode())
+        assert "crdt_canary_visibility_seconds" in fams
+        assert fams["crdt_canary_visibility_seconds"]["samples"]
+        # the canary rode the real admission path under its own tid
+        tid = cst["last_probe"]["trace_id"]
+        spans = fleet["solo"].node.fleettrace.spans(tid)
+        assert any(s["kind"] == "admission" for s in spans)
+    finally:
+        _stop_fleet(fleet)
+    monkeypatch.setenv("GRAFT_CANARY", "0")
+    kv2 = MemoryKV()
+    fleet2 = _spawn_fleet(kv2, ("off",))
+    try:
+        assert fleet2["off"].node.canary is None
+    finally:
+        _stop_fleet(fleet2)
+
+
+@pytest.mark.slow
+def test_bench_visibility_headline_full(tmp_path):
+    """The committed-artifact run (BENCH_VISIBILITY_r01_cpu.json
+    shape): 3-node oracle-checked loadgen leg with sub-second canary
+    ticks — per-stage visibility lag p50/p99 present, canary overhead
+    under 1% of acked throughput, zero violations.  Slow-marked; the
+    tier-1 gates are the fast tests above."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "_bench_visibility_headline",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_visibility_headline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(out_path=str(tmp_path / "BENCH_VISIBILITY_test.json"))
+    assert out["gate"]["pass"], out["gate"]
+    assert out["violations_total"] == 0
+    for stage in ("publish", "replica"):
+        lag = out["visibility_lag_s"][stage]
+        assert lag["count"] > 0 and lag["p99"] is not None
+    assert out["canary"]["probes"] >= 1
+    assert out["canary"]["overhead_pct_of_acked"] < 1.0
+
+
+# -- GRAFT_FLEETTRACE=0: the byte-identical wire revert ----------------------
+
+
+def test_fleettrace_disabled_reverts_wire(monkeypatch):
+    """With GRAFT_FLEETTRACE=0 every new wire surface disappears: no
+    X-Span-Ctx on the relay, no X-Trace-Frontier on /ops windows, no
+    spans recorded anywhere, fleet families absent from the scrape —
+    the PR-19 baseline, byte for byte."""
+    monkeypatch.setenv("GRAFT_FLEETTRACE", "0")
+    kv = MemoryKV()
+    fleet = _spawn_fleet(kv, ("n0", "n1"))
+    try:
+        ring = fleet["n0"].node.ring()
+        doc = _doc_owned_by(ring, "n1")
+        st, raw, hdr = req(fleet["n0"].port, "POST",
+                           f"/docs/{doc}/ops", body=_chain(6, 4))
+        assert st == 200
+        # trace id still propagates (the satellite-1 bugfix is NOT
+        # gated — attribution is baseline behavior)
+        tid = json.loads(raw)["trace_id"]
+        assert hdr["X-Trace-Id"] == tid
+        # ...but no span state accrued anywhere
+        assert fleet["n0"].node.fleettrace.trace_count() == 0
+        assert fleet["n1"].node.fleettrace.trace_count() == 0
+        st, _, ohdr = req(fleet["n1"].port, "GET",
+                          f"/docs/{doc}/ops?since=0&limit=32")
+        assert st == 200
+        assert TRACE_FRONTIER_HEADER not in ohdr
+        st, raw, _ = req(fleet["n1"].port, "GET", "/metrics/prom")
+        fams = prom_mod.parse_text(raw.decode())
+        assert not [f for f in fams
+                    if f.startswith(("crdt_fleettrace_",
+                                     "crdt_visibility_"))]
+        # the ledger stayed empty too (no commit stamping)
+        assert fleet["n1"].node.ledger.stats()["commits"] == 0
+    finally:
+        _stop_fleet(fleet)

@@ -394,8 +394,8 @@ def test_breaker_opens_and_probes_recovered_worker():
     # closes the breaker with one success
     worker._dead = False
     time.sleep(0.06)
-    table, shared, width = mt.merge_one("b0", p, p.num_ops)
-    assert width == 1 and shared >= p.capacity
+    table, shared, width, sub = mt.merge_one("b0", p, p.num_ops)
+    assert width == 1 and shared >= p.capacity and sub is None
     ws = mt.stats()["workers"][0]
     assert not ws["breaker_open"] and ws["ok"] == 1
     worker.close()
